@@ -1,0 +1,39 @@
+"""Test harness: run all tests on a virtual 8-device CPU mesh.
+
+Mirrors how the reference exercised its distributed path on one machine
+(multiple slaves against one CommMaster, reference: bin/cluster_optimizer.sh)
+— here XLA's host-platform device-count flag gives us 8 virtual devices so
+every psum/psum_scatter/all_gather path runs for real, without TPU hardware.
+
+Must set env vars before jax is imported anywhere.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: the shell env may pin a TPU platform
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import jax  # noqa: E402
+
+# sitecustomize may have imported jax already (TPU plugin registration), in
+# which case jax.config captured the env at that import — override explicitly.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+jax.config.update("jax_enable_x64", True)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    return jax.devices()
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    from ytklearn_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(n_devices=8)

@@ -1,0 +1,60 @@
+"""Collective substrate tests on the 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from ytklearn_tpu.parallel import DATA_AXIS, collectives as coll, make_mesh
+
+
+def test_psum_and_scatter_and_gather(mesh8):
+    n = 8
+
+    @jax.jit
+    def run(x):
+        def f(xs):
+            s = coll.psum(jnp.sum(xs))
+            sc = coll.psum_scatter(jnp.ones((n * 2,)) * (coll.axis_index() + 1))
+            ag = coll.all_gather(xs)
+            return s * jnp.ones_like(xs), sc, ag
+
+        return shard_map(
+            f,
+            mesh=mesh8,
+            in_specs=P(DATA_AXIS),
+            out_specs=(P(DATA_AXIS), P(DATA_AXIS), P(None)),
+            check_vma=False,
+        )(x)
+
+    x = jnp.arange(16, dtype=jnp.float32)
+    s, sc, ag = run(x)
+    np.testing.assert_allclose(s, jnp.full((16,), x.sum()))
+    # psum_scatter of per-rank constant (r+1) over 16 slots -> each slot sums ranks = 36
+    np.testing.assert_allclose(sc, jnp.full((16,), sum(range(1, 9))))
+    np.testing.assert_allclose(ag, x)
+
+
+def test_pargmax_tuple_tie_break(mesh8):
+    scores = jnp.array([1.0, 5.0, 3.0, 5.0, 2.0, 0.0, 5.0, 4.0])
+    payload = jnp.arange(8, dtype=jnp.float32) * 10
+
+    @jax.jit
+    def run(s, p):
+        def f(s, p):
+            best, pay = coll.pargmax_tuple(s[0], {"v": p[0]})
+            return jnp.array([best]), jnp.array([pay["v"]])
+
+        return shard_map(
+            f,
+            mesh=mesh8,
+            in_specs=(P(DATA_AXIS), P(DATA_AXIS)),
+            out_specs=P(None),
+            check_vma=False,
+        )(s, p)
+
+    best, v = run(scores, payload)
+    assert float(best[0]) == 5.0
+    # ranks 1, 3, 6 tie at 5.0; lowest rank (1) wins -> payload 10
+    assert float(v[0]) == 10.0

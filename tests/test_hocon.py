@@ -1,0 +1,133 @@
+"""HOCON parser tests: must parse all 9 unchanged reference model configs."""
+
+import glob
+import os
+
+import pytest
+
+from ytklearn_tpu.config import hocon
+from ytklearn_tpu.config.params import CommonParams, GBDTParams
+
+REF_CONF = "/root/reference/config/model"
+
+
+def test_basic_scalars():
+    cfg = hocon.loads(
+        """
+        a : 1,
+        b : 2.5
+        c : "str"
+        d : unquoted string
+        e : true
+        f : ???
+        # comment
+        // comment too
+        g { h : [1, 2, 3], i : { j : -1e-3 } }
+        """
+    )
+    assert cfg["a"] == 1
+    assert cfg["b"] == 2.5
+    assert cfg["c"] == "str"
+    assert cfg["d"] == "unquoted string"
+    assert cfg["e"] is True
+    assert cfg["f"] is hocon.MISSING
+    assert cfg["g"]["h"] == [1, 2, 3]
+    assert cfg["g"]["i"]["j"] == -1e-3
+
+
+def test_dotted_keys_and_merge():
+    cfg = hocon.loads("a.b.c : 1\na { b { d : 2 } }")
+    assert cfg["a"]["b"] == {"c": 1, "d": 2}
+
+
+def test_array_of_objects():
+    cfg = hocon.loads('xs : [ {cols: "default", type: "sample_by_quantile", max_cnt: 255}, ]')
+    assert cfg["xs"][0]["max_cnt"] == 255
+
+
+def test_trailing_commas_and_comments_inline():
+    cfg = hocon.loads('mode : "lines_avg" // "files_avg"\nn : 3,')
+    assert cfg["mode"] == "lines_avg"
+    assert cfg["n"] == 3
+
+
+def test_set_get_path():
+    cfg = hocon.loads("a { b : 1 }")
+    hocon.set_path(cfg, "a.c.d", "2")
+    assert hocon.get_path(cfg, "a.c.d") == 2
+    assert hocon.get_path(cfg, "a.b") == 1
+    assert hocon.get_path(cfg, "nope.x", "dflt") == "dflt"
+
+
+@pytest.mark.parametrize(
+    "name",
+    [os.path.basename(p) for p in sorted(glob.glob(f"{REF_CONF}/*.conf"))],
+)
+def test_parses_all_reference_configs(name):
+    cfg = hocon.load(f"{REF_CONF}/{name}")
+    assert isinstance(cfg, dict)
+    assert "data" in cfg and "model" in cfg
+    assert hocon.get_path(cfg, "data.delim.x_delim") == "###"
+
+
+def test_common_params_linear():
+    cfg = hocon.load(f"{REF_CONF}/linear.conf")
+    hocon.set_path(cfg, "data.train.data_path", "/tmp/x")
+    hocon.set_path(cfg, "model.data_path", "/tmp/m")
+    p = CommonParams.from_config(cfg)
+    assert p.loss.loss_function == "sigmoid"
+    assert p.loss.l1 == [5.28e-9]
+    assert p.line_search.lbfgs_m == 8
+    assert p.line_search.mode == "wolfe"
+    assert p.model.need_bias is True
+    assert p.data.unassigned_mode == "lines_avg"
+
+
+def test_common_params_fm():
+    cfg = hocon.load(f"{REF_CONF}/fm.conf")
+    hocon.set_path(cfg, "data.train.data_path", "/tmp/x")
+    hocon.set_path(cfg, "model.data_path", "/tmp/m")
+    p = CommonParams.from_config(cfg)
+    assert p.k == [1, 8]
+    assert p.random.mode == "normal"
+    assert p.random.seed == 111111
+    assert p.bias_need_latent_factor is False
+
+
+def test_common_params_ffm_field_delim():
+    cfg = hocon.load(f"{REF_CONF}/ffm.conf")
+    hocon.set_path(cfg, "data.train.data_path", "/tmp/x")
+    hocon.set_path(cfg, "model.data_path", "/tmp/m")
+    p = CommonParams.from_config(cfg)
+    assert p.data.delim.field_delim == "@"
+    assert p.k == [1, 4]
+
+
+def test_gbdt_params():
+    cfg = hocon.load(f"{REF_CONF}/gbdt.conf")
+    hocon.set_path(cfg, "data.train.data_path", "/tmp/x")
+    hocon.set_path(cfg, "data.test.data_path", "/tmp/t")
+    hocon.set_path(cfg, "model.data_path", "/tmp/m")
+    hocon.set_path(cfg, "data.max_feature_dim", 28)
+    hocon.set_path(cfg, "model.feature_importance_path", "/tmp/fi")
+    p = GBDTParams.from_config(cfg)
+    assert p.tree_maker == "data"
+    assert p.round_num == 50
+    assert p.max_leaf_cnt == 128
+    assert p.learning_rate == 0.09
+    assert p.approximate[0].type == "sample_by_quantile"
+    assert p.approximate[0].max_cnt == 255
+    assert p.missing_value == "value"
+    assert p.data.max_feature_dim == 28
+    assert p.num_tree_in_group == 1
+
+
+def test_gbst_params():
+    cfg = hocon.load(f"{REF_CONF}/gbmlr.conf")
+    hocon.set_path(cfg, "data.train.data_path", "/tmp/x")
+    hocon.set_path(cfg, "model.data_path", "/tmp/m")
+    p = CommonParams.from_config(cfg)
+    assert p.k == 16
+    assert p.tree_num == 1
+    assert p.gbst_type == "gradient_boosting"
+    assert p.uniform_base_prediction == 0.5

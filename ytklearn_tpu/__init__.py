@@ -1,0 +1,10 @@
+"""ytklearn_tpu — a TPU-native distributed classical-ML training framework.
+
+A ground-up JAX/XLA/Pallas rebuild of the capabilities of ytk-learn
+(linear / multiclass linear / FM / FFM / GBDT / gradient-boosted soft trees,
+distributed training, text model formats, online prediction), designed
+TPU-first: SPMD over `jax.sharding.Mesh`, jit-compiled update steps, XLA
+collectives over ICI instead of the reference's ytk-mp4j TCP collectives.
+"""
+
+__version__ = "0.1.0"

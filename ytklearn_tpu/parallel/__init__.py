@@ -1,0 +1,21 @@
+from .mesh import (
+    DATA_AXIS,
+    distributed_initialize_if_needed,
+    make_mesh,
+    pad_rows_to_multiple,
+    replicated,
+    row_sharding,
+    shard_rows,
+)
+from . import collectives
+
+__all__ = [
+    "DATA_AXIS",
+    "collectives",
+    "distributed_initialize_if_needed",
+    "make_mesh",
+    "pad_rows_to_multiple",
+    "replicated",
+    "row_sharding",
+    "shard_rows",
+]

@@ -1,0 +1,111 @@
+"""The mp4j collective surface mapped onto XLA collectives.
+
+The reference funnels every cross-worker exchange through ~10 ytk-mp4j verbs
+(catalogued in SURVEY.md §1-L1 from grepping all call sites). This module is
+the one-to-one TPU mapping; everything here is meant to run inside
+`shard_map` over the mesh's data axis:
+
+| mp4j verb (reference call site)                         | here               |
+|---------------------------------------------------------|--------------------|
+| allreduce scalar/array  (HoagOptimizer.java:1038)       | psum / pmax / pmin |
+| reduceScatterArray      (HistogramBuilder.java:95)      | psum_scatter       |
+| allgatherArray          (HoagOptimizer.java:916,928)    | all_gather         |
+| object argmax allreduce (DataParallelTreeMaker.java:642)| pargmax_tuple      |
+| allreduceMap (GK summaries, CoreData.java:628)          | host-side merge at |
+|                                                         | load time (io/)    |
+
+Object/map collectives carrying Kryo-serialized Java objects have no ICI
+equivalent; the hot one (SplitInfo argmax) becomes a fixed-shape dense
+reduction (`pargmax_tuple`), the cold ones (load-time quantile-sketch merges)
+run on host via process_allgather.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .mesh import DATA_AXIS
+
+
+def psum(x, axis_name: str = DATA_AXIS):
+    return lax.psum(x, axis_name)
+
+
+def pmax(x, axis_name: str = DATA_AXIS):
+    return lax.pmax(x, axis_name)
+
+
+def pmin(x, axis_name: str = DATA_AXIS):
+    return lax.pmin(x, axis_name)
+
+
+def psum_scatter(x, axis_name: str = DATA_AXIS, tiled: bool = True):
+    """reduceScatterArray equivalent: global sum, each rank keeps its slice.
+
+    With tiled=True, input of shape (k*n_ranks, ...) returns (k, ...) — the
+    same contiguous-slice ownership the reference's 2-D partition tables
+    express (CommUtils.createThreadArrayFroms/Tos)."""
+    return lax.psum_scatter(x, axis_name, tiled=tiled)
+
+
+def all_gather(x, axis_name: str = DATA_AXIS, tiled: bool = True):
+    """allgatherArray equivalent: concatenate each rank's slice along dim 0."""
+    return lax.all_gather(x, axis_name, tiled=tiled)
+
+
+def pargmax_tuple(score, payload, axis_name: str = DATA_AXIS):
+    """Global argmax with deterministic tie-break — the TPU replacement for
+    the reference's object-allreduce of SplitInfo (best-split sync,
+    optimizer/gbdt/DataParallelTreeMaker.java:640-653; tie-break semantics
+    from data/gbdt/SplitInfo.needReplace:99: higher score wins, ties broken
+    toward the lower rank index).
+
+    score: scalar per rank; payload: pytree of scalars to carry along.
+    Returns (best_score, best_payload) replicated on all ranks.
+    """
+    idx = lax.axis_index(axis_name)
+    n = lax.axis_size(axis_name)
+    best = lax.pmax(score, axis_name)
+    # Ranks holding the best score vote with their index; lowest rank wins.
+    my_vote = jnp.where(score >= best, idx, n)
+    winner = lax.pmin(my_vote, axis_name)
+    is_winner = (idx == winner).astype(score.dtype)
+
+    def pick(leaf):
+        leaf = jnp.asarray(leaf)
+        return lax.psum(leaf * is_winner.astype(leaf.dtype), axis_name)
+
+    return best, jax.tree_util.tree_map(pick, payload)
+
+
+def axis_index(axis_name: str = DATA_AXIS):
+    return lax.axis_index(axis_name)
+
+
+def axis_size(axis_name: str = DATA_AXIS):
+    return lax.axis_size(axis_name)
+
+
+# ---------------------------------------------------------------------------
+# Host-side (load-time) small-object merges — replaces allreduceMap /
+# allreduceMapSetUnion for feature dicts & sketches across processes.
+# ---------------------------------------------------------------------------
+
+
+def host_allgather_objects(obj):
+    """Gather a small python object from every process (multi-host only).
+
+    Single-process returns [obj]. The multi-host path uses
+    jax.experimental.multihost_utils over DCN — acceptable because these
+    merges happen once at load time (the reference likewise routed them
+    through the master's TCP link, not the hot path)."""
+    if jax.process_count() == 1:
+        return [obj]
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.process_allgather(obj, tiled=False)

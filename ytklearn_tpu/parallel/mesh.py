@@ -1,0 +1,67 @@
+"""Device mesh construction — the TPU equivalent of ytk-mp4j topology.
+
+The reference's communication world is `slaveNum × threadNum` ranks joined
+through a CommMaster TCP rendezvous (reference: worker/TrainWorker.java:139,
+bin/local_optimizer.sh:38-47). Here the world is a `jax.sharding.Mesh`:
+devices are the ranks, `jax.distributed.initialize` is the rendezvous on
+multi-host pods, and collectives ride ICI instead of ethernet.
+
+One named axis, DATA_AXIS, carries row-sharded data parallelism (the
+reference's only cross-worker axis). Model-parallel shardings (L-BFGS
+history slices, GBDT histogram bin slices) reuse the same axis via
+psum_scatter / all_gather, exactly mirroring how the reference overlays
+slice ownership on the same rank grid (reference:
+optimizer/HoagOptimizer.java:442-449, data/gbdt/HistogramBuilder.java:95).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+
+
+def make_mesh(n_devices: Optional[int] = None, axis_name: str = DATA_AXIS) -> Mesh:
+    """1-D mesh over (a prefix of) the available devices."""
+    devs = jax.devices()
+    if n_devices is not None:
+        if len(devs) < n_devices:
+            raise ValueError(f"need {n_devices} devices, have {len(devs)}")
+        devs = devs[:n_devices]
+    return Mesh(np.asarray(devs), (axis_name,))
+
+
+def distributed_initialize_if_needed() -> None:
+    """Multi-host rendezvous: replaces the reference's CommMaster process.
+
+    On TPU pods, coordinator discovery comes from the runtime/env; on CPU/GPU
+    clusters, standard jax.distributed env vars apply. No-op single-process.
+    """
+    if os.environ.get("YTKLEARN_TPU_DISTRIBUTED", "0") == "1" and jax.process_count() == 1:
+        jax.distributed.initialize()
+
+
+def row_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard dim 0 (rows/samples) across the data axis; replicate the rest."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def pad_rows_to_multiple(n: int, k: int) -> int:
+    """Rows must pad to a multiple of the mesh size for even sharding; the
+    reference instead allowed ragged per-worker row counts
+    (dataflow/DataFlow.java:391-410) — padding + weight-masking is the
+    static-shape equivalent."""
+    return (n + k - 1) // k * k
+
+
+def shard_rows(arr, mesh: Mesh):
+    """Device-put a host array with rows sharded over the data axis."""
+    return jax.device_put(arr, row_sharding(mesh))
