@@ -54,7 +54,11 @@ def test_trailing_commas_and_comments_inline():
 def test_set_get_path():
     cfg = hocon.loads("a { b : 1 }")
     hocon.set_path(cfg, "a.c.d", "2")
-    assert hocon.get_path(cfg, "a.c.d") == 2
+    # withValue keeps the given type: strings stay strings (ADVICE r1 --
+    # a data-path override like "2024" must not become an int)
+    assert hocon.get_path(cfg, "a.c.d") == "2"
+    hocon.set_path(cfg, "a.c.e", 3)
+    assert hocon.get_path(cfg, "a.c.e") == 3
     assert hocon.get_path(cfg, "a.b") == 1
     assert hocon.get_path(cfg, "nope.x", "dflt") == "dflt"
 

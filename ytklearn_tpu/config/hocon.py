@@ -221,7 +221,9 @@ def _coerce(raw: str) -> Any:
         return True
     if low == "false":
         return False
-    if low in ("null", "none"):
+    if low == "null":
+        # typesafe-config treats only `null` as null; an unquoted `none`
+        # stays a string (ADVICE r1).
         return None
     try:
         return int(raw)
@@ -285,8 +287,11 @@ def get_path(cfg: dict, path: str, default: Any = None) -> Any:
 
 def set_path(cfg: dict, path: str, value: Any) -> dict:
     """`config.withValue` equivalent (reference: worker/TrainWorker.java:118-131),
-    used for programmatic/custom-param overrides. Mutates and returns cfg."""
-    _set_dotted(cfg, path, value if not isinstance(value, str) else _coerce(value))
+    used for programmatic/custom-param overrides. Mutates and returns cfg.
+
+    Values keep the type they are given (`withValue` semantics) — a string
+    "2024" stays a string; callers wanting coercion parse before calling."""
+    _set_dotted(cfg, path, value)
     return cfg
 
 

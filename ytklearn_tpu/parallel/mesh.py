@@ -36,14 +36,22 @@ def make_mesh(n_devices: Optional[int] = None, axis_name: str = DATA_AXIS) -> Me
     return Mesh(np.asarray(devs), (axis_name,))
 
 
-def distributed_initialize_if_needed() -> None:
-    """Multi-host rendezvous: replaces the reference's CommMaster process.
+def distributed_initialize_if_needed(**kwargs) -> None:
+    """Multi-host rendezvous: replaces the reference's CommMaster process
+    (reference: worker/TrainWorker.java:139, bin/local_optimizer.sh:38-47).
 
-    On TPU pods, coordinator discovery comes from the runtime/env; on CPU/GPU
-    clusters, standard jax.distributed env vars apply. No-op single-process.
+    MUST run before any other JAX API touches the backend — querying
+    `jax.process_count()` first would initialize the local backend and make
+    distributed init a no-op (ADVICE r1). Set YTKLEARN_TPU_DISTRIBUTED=1 (or
+    pass coordinator kwargs) in each process of a multi-host launch; on TPU
+    pods coordinator discovery comes from the runtime metadata, on CPU/GPU
+    clusters the standard jax.distributed env vars/kwargs apply.
     """
-    if os.environ.get("YTKLEARN_TPU_DISTRIBUTED", "0") == "1" and jax.process_count() == 1:
-        jax.distributed.initialize()
+    if os.environ.get("YTKLEARN_TPU_DISTRIBUTED", "0") != "1" and not kwargs:
+        return
+    if jax.distributed.is_initialized():
+        return
+    jax.distributed.initialize(**kwargs)
 
 
 def row_sharding(mesh: Mesh) -> NamedSharding:
