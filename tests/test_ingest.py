@@ -1,0 +1,182 @@
+"""Ingest tests: murmur3 vectors, parsing, dict building, demo-data load."""
+
+import os
+
+import numpy as np
+import pytest
+
+from ytklearn_tpu.config import hocon
+from ytklearn_tpu.config.params import CommonParams, DelimParams
+from ytklearn_tpu.io.feature_hash import FeatureHash, murmur3_x64_128
+from ytklearn_tpu.io.reader import DataIngest, TransformNode, parse_line
+
+REF = "/root/reference"
+AGARICUS_TRAIN = f"{REF}/demo/data/ytklearn/agaricus.train.ytklearn"
+AGARICUS_TEST = f"{REF}/demo/data/ytklearn/agaricus.test.ytklearn"
+LINEAR_CONF = f"{REF}/demo/linear/binary_classification/linear.conf"
+
+
+def test_murmur3_known_vectors():
+    # Vectors from an independent transcription of the canonical
+    # MurmurHash3_x64_128 C reference (two separate transcriptions agree);
+    # empty-string/seed-0 -> (0,0) is the canonical smhasher fact.
+    assert murmur3_x64_128(b"", 0) == (0, 0)
+    h1, h2 = murmur3_x64_128(b"hello", 0)
+    assert h1 == 0xC8C47CAC472AAEC9
+    assert h2 == 0x50FA4DD262342FEB
+    h1, h2 = murmur3_x64_128(b"hello, world", 0)
+    assert h1 == 0xF197CC8F86C1E486
+    assert h2 == 0x7A4F36E18948D136
+    # covers the >=9-byte tail path (k2 branch)
+    h1a, _ = murmur3_x64_128(b"0123456789abcdef0", 7)  # 17 bytes, 1-byte tail
+    h1b, _ = murmur3_x64_128(b"0123456789abcdef0", 8)
+    assert h1a != h1b  # seed matters
+    # determinism
+    assert murmur3_x64_128(b"abcdefghijklm", 42) == murmur3_x64_128(b"abcdefghijklm", 42)
+
+
+def test_murmur3_bucket_distribution():
+    # sign-trick hashing should spread names ~uniformly and split signs ~50/50
+    fh = FeatureHash(bucket_size=64, seed=39916801)
+    buckets = {}
+    signs = 0
+    for i in range(2000):
+        name, sign = fh.hash_name(f"feat_{i}")
+        buckets[name] = buckets.get(name, 0) + 1
+        signs += sign > 0
+    assert len(buckets) == 64  # all buckets hit
+    assert 850 <= signs <= 1150  # ~binomial(2000, .5)
+
+
+def test_feature_hash_sign_and_bucket():
+    fh = FeatureHash(bucket_size=1000, seed=39916801, prefix="hash_")
+    name, sign = fh.hash_name("feature_42")
+    assert name.startswith("hash_")
+    assert 0 <= int(name[len("hash_"):]) < 1000
+    assert sign in (-1.0, 1.0)
+    # deterministic
+    assert fh.hash_name("feature_42") == (name, sign)
+    # collisions sum signed values
+    merged = dict(fh.hash_features([("a", 1.0), ("a", 2.0)]))
+    (only,) = merged.values()
+    _, s = fh.hash_name("a")
+    assert only == pytest.approx(s * 3.0)
+
+
+def test_parse_line_basic():
+    pl = parse_line("2.5###1###f1:0.5,f2:-3", DelimParams())
+    assert pl.weight == 2.5
+    assert pl.labels == [1.0]
+    assert pl.feats == [("f1", 0.5), ("f2", -3.0)]
+
+
+def _linear_params(tmp_path):
+    cfg = hocon.load(LINEAR_CONF)
+    cfg = hocon.set_path(cfg, "data.train.data_path", AGARICUS_TRAIN)
+    cfg = hocon.set_path(cfg, "data.test.data_path", AGARICUS_TEST)
+    cfg = hocon.set_path(cfg, "model.data_path", str(tmp_path / "lr.model"))
+    return CommonParams.from_config(cfg)
+
+
+def test_agaricus_ingest(tmp_path):
+    p = _linear_params(tmp_path)
+    ing = DataIngest(p)
+    res = ing.load()
+    tr, te = res.train, res.test
+    # agaricus: 6513 train / 1611 test rows, 117 distinct train features + bias
+    assert tr.n_real == 6513
+    assert te.n_real == 1611
+    assert tr.dim == 118
+    assert res.feature_map["_bias_"] == 0
+    # dict is sorted by name after bias (TreeSet semantics)
+    names = sorted(n for n in res.feature_map if n != "_bias_")
+    assert [res.feature_map[n] for n in names] == list(range(1, len(names) + 1))
+    # bias slot present in every row
+    assert (tr.idx[:, 0] == 0).all() and (tr.val[:, 0] == 1.0).all()
+    # labels binary, weights 1
+    assert set(np.unique(tr.y)) <= {0.0, 1.0}
+    assert (tr.weight == 1.0).all()
+    # padding rows: none yet
+    padded = tr.pad_rows(8)
+    assert padded.n % 8 == 0
+    assert padded.weight[tr.n_real:].sum() == 0.0
+
+
+def test_filter_threshold_and_dict_roundtrip(tmp_path):
+    data = tmp_path / "mini.ytk"
+    data.write_text(
+        "1###1###a:1,b:2\n"
+        "1###0###a:3,c:4\n"
+        "1###1###a:5\n"
+    )
+    cfg = hocon.load(LINEAR_CONF)
+    cfg = hocon.set_path(cfg, "data.train.data_path", str(data))
+    cfg = hocon.set_path(cfg, "data.test.data_path", "")
+    cfg = hocon.set_path(cfg, "model.data_path", str(tmp_path / "m.model"))
+    cfg = hocon.set_path(cfg, "feature.filter_threshold", 2)
+    p = CommonParams.from_config(cfg)
+    ing = DataIngest(p)
+    res = ing.load()
+    # only 'a' (cnt 3) survives threshold 2; b,c dropped
+    assert set(res.feature_map) == {"_bias_", "a"}
+    assert res.train.dim == 2
+    # rows keep bias + a
+    assert res.train.idx.shape[1] == 2
+
+    # dict load path: write a dict file, need_dict=true
+    dict_file = tmp_path / "dict.txt"
+    dict_file.write_text("z\ny\nx\n")
+    cfg2 = hocon.set_path(cfg, "model.need_dict", True)
+    cfg2 = hocon.set_path(cfg2, "model.dict_path", str(dict_file))
+    p2 = CommonParams.from_config(cfg2)
+    fmap = DataIngest(p2).load_feature_map([str(dict_file)])
+    assert fmap == {"_bias_": 0, "z": 1, "y": 2, "x": 3}
+
+
+def test_transform_standardization(tmp_path):
+    data = tmp_path / "t.ytk"
+    data.write_text(
+        "1###1###a:1\n"
+        "1###0###a:3\n"
+    )
+    cfg = hocon.load(LINEAR_CONF)
+    cfg = hocon.set_path(cfg, "data.train.data_path", str(data))
+    cfg = hocon.set_path(cfg, "data.test.data_path", "")
+    cfg = hocon.set_path(cfg, "model.data_path", str(tmp_path / "m.model"))
+    cfg = hocon.set_path(cfg, "feature.transform.switch_on", True)
+    p = CommonParams.from_config(cfg)
+    res = DataIngest(p).load()
+    # mean 2, std 1 -> values become -1, +1
+    a_col = res.train.val[:, 1]
+    np.testing.assert_allclose(sorted(a_col), [-1.0, 1.0], atol=1e-6)
+    # sidecar written and parseable
+    sidecar = str(tmp_path / "m.model") + "_feature_transform_stat"
+    assert os.path.exists(sidecar)
+    line = open(sidecar).read().strip()
+    name, _, payload = line.partition("###")
+    assert name == "a"
+    node = TransformNode.from_string(payload)
+    assert node.mean == pytest.approx(2.0)
+    assert node.stdvar == pytest.approx(1.0)
+    # round-trip through load_transform_sidecar
+    nodes = DataIngest(p).load_transform_sidecar(res.feature_map)
+    assert nodes[res.feature_map["a"]].mean == pytest.approx(2.0)
+
+
+def test_y_sampling_weight_correction(tmp_path):
+    data = tmp_path / "s.ytk"
+    lines = ["1###0###a:1\n"] * 100 + ["1###1###a:1\n"] * 10
+    data.write_text("".join(lines))
+    cfg = hocon.load(LINEAR_CONF)
+    cfg = hocon.set_path(cfg, "data.train.data_path", str(data))
+    cfg = hocon.set_path(cfg, "data.test.data_path", "")
+    cfg = hocon.set_path(cfg, "model.data_path", str(tmp_path / "m.model"))
+    cfg = hocon.set_path(cfg, "data.y_sampling", ["0@0.5"])
+    p = CommonParams.from_config(cfg)
+    res = DataIngest(p).load()
+    tr = res.train
+    kept0 = (tr.y == 0).sum()
+    assert 20 <= kept0 <= 80  # ~50 in expectation
+    # kept label-0 rows carry inverse-probability weight 2.0
+    assert (tr.weight[tr.y == 0] == 2.0).all()
+    assert (tr.weight[tr.y == 1] == 1.0).all()

@@ -236,7 +236,7 @@ def _coerce(raw: str) -> Any:
     return raw
 
 
-def _set_dotted(obj: dict, key: str, value: Any) -> None:
+def _set_dotted(obj: dict, key: str, value: Any, merge: bool = True) -> None:
     parts = key.split(".")
     cur = obj
     for p in parts[:-1]:
@@ -247,7 +247,7 @@ def _set_dotted(obj: dict, key: str, value: Any) -> None:
         cur = nxt
     last = parts[-1]
     old = cur.get(last)
-    if isinstance(old, dict) and isinstance(value, dict):
+    if merge and isinstance(old, dict) and isinstance(value, dict):
         _deep_merge(old, value)
     else:
         cur[last] = value
@@ -290,8 +290,10 @@ def set_path(cfg: dict, path: str, value: Any) -> dict:
     used for programmatic/custom-param overrides. Mutates and returns cfg.
 
     Values keep the type they are given (`withValue` semantics) — a string
-    "2024" stays a string; callers wanting coercion parse before calling."""
-    _set_dotted(cfg, path, value)
+    "2024" stays a string; callers wanting coercion parse before calling.
+    Dict values *replace* the subtree (withValue replaces; only the HOCON
+    parser's duplicate-key handling deep-merges)."""
+    _set_dotted(cfg, path, value, merge=False)
     return cfg
 
 

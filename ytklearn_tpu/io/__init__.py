@@ -1,0 +1,2 @@
+from .fs import FileSystem, LocalFileSystem, create_filesystem
+from .feature_hash import FeatureHash, murmur3_x64_128_h1

@@ -1,0 +1,113 @@
+"""Storage seam — the rebuild of the reference `fs/` package.
+
+All framework I/O (data readers, model dump/load, dict/transform sidecars)
+routes through a FileSystem so remote schemes can slot in without touching
+callers (reference: fs/IFileSystem.java:35-46, fs/LocalFileSystem.java:39,
+factory fs/FileSystemFactory.java:54).
+"""
+
+from __future__ import annotations
+
+import os
+import glob as _glob
+from typing import IO, Iterable, Iterator, List, Sequence
+
+
+class FileSystem:
+    """Interface (reference: fs/IFileSystem.java:35-46)."""
+
+    def exists(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def open(self, path: str, mode: str = "r") -> IO:
+        raise NotImplementedError
+
+    def mkdirs(self, path: str) -> None:
+        raise NotImplementedError
+
+    def delete(self, path: str) -> None:
+        raise NotImplementedError
+
+    def recur_get_paths(self, paths: Sequence[str]) -> List[str]:
+        """Expand directories (recursively) and globs into a flat file list
+        (reference: IFileSystem.recurGetPaths)."""
+        raise NotImplementedError
+
+    # -- line-oriented helpers used by the data layer --------------------
+
+    def read_lines(self, paths: Sequence[str]) -> Iterator[str]:
+        """All lines of all files, in sorted-path order."""
+        for p in sorted(self.recur_get_paths(paths)):
+            with self.open(p) as f:
+                for line in f:
+                    yield line.rstrip("\n")
+
+    def select_read_lines(
+        self, paths: Sequence[str], divisor: int, remainder: int
+    ) -> Iterator[str]:
+        """Line-modulo sharding: keep global line i iff i % divisor == remainder
+        — the `lines_avg` worker assignment (reference: IFileSystem.selectRead,
+        dataflow/DataFlow.java:405)."""
+        for i, line in enumerate(self.read_lines(paths)):
+            if i % divisor == remainder:
+                yield line
+
+
+class LocalFileSystem(FileSystem):
+    """reference: fs/LocalFileSystem.java:39."""
+
+    def _strip(self, path: str) -> str:
+        if path.startswith("file://"):
+            path = path[len("file://"):]
+        return path
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(self._strip(path))
+
+    def open(self, path: str, mode: str = "r") -> IO:
+        path = self._strip(path)
+        if any(m in mode for m in ("w", "a")):
+            parent = os.path.dirname(os.path.abspath(path))
+            os.makedirs(parent, exist_ok=True)
+        return open(path, mode)
+
+    def mkdirs(self, path: str) -> None:
+        os.makedirs(self._strip(path), exist_ok=True)
+
+    def delete(self, path: str) -> None:
+        path = self._strip(path)
+        if os.path.isdir(path):
+            import shutil
+
+            shutil.rmtree(path)
+        elif os.path.exists(path):
+            os.remove(path)
+
+    def recur_get_paths(self, paths: Sequence[str]) -> List[str]:
+        out: List[str] = []
+        for p in paths:
+            p = self._strip(p)
+            if os.path.isdir(p):
+                for root, _dirs, files in os.walk(p):
+                    for f in files:
+                        out.append(os.path.join(root, f))
+            elif os.path.exists(p):
+                out.append(p)
+            else:
+                hits = sorted(_glob.glob(p))
+                if not hits:
+                    raise FileNotFoundError(p)
+                out.extend(hits)
+        return out
+
+
+def create_filesystem(scheme_or_uri: str = "local") -> FileSystem:
+    """Scheme -> FileSystem (reference: fs/FileSystemFactory.java:54).
+
+    `local` / `file` map to LocalFileSystem; gcs/hdfs raise until a remote
+    backend is wired (the seam exists so callers never hard-code open())."""
+    scheme = scheme_or_uri.split("://")[0] if "://" in scheme_or_uri else scheme_or_uri
+    scheme = (scheme or "local").lower()
+    if scheme in ("local", "file", ""):
+        return LocalFileSystem()
+    raise NotImplementedError(f"filesystem scheme {scheme!r} not available (local only)")

@@ -1,0 +1,537 @@
+"""Data ingest — the TPU rebuild of the reference DataFlow/CoreData load path.
+
+The reference parses text lines into per-thread blocked-CSR int arrays
+(reference: dataflow/CoreData.java:536-645, dataflow/DataFlow.java:468-765).
+Here the terminal format is *padded ELL* arrays — `(n, width)` feature-index
+and value matrices — because static shapes are what XLA wants: Xv becomes a
+gather+reduce, XTv a segment-sum, both jit-able with no ragged rows.
+
+Pipeline (mirrors DataFlow.loadFlow):
+    lines -> (py transform hook) -> parse (weight###label###f:v,...)
+          -> y-sampling / error tolerance
+          -> feature count map + transform stats        [train only]
+          -> feature dict build (sorted names) or load  [train only]
+          -> transform value rewrite
+          -> ELL arrays (bias at index 0 when need_bias)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import random
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..config.params import CommonParams, DelimParams
+from .feature_hash import FeatureHash
+from .fs import FileSystem, LocalFileSystem
+
+
+# ---------------------------------------------------------------------------
+# Line parsing
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ParsedLine:
+    weight: float
+    labels: List[float]  # 1 entry for scalar losses; K for multiclass
+    feats: List[Tuple[str, float]]
+
+
+def parse_line(line: str, delim: DelimParams) -> ParsedLine:
+    """`weight###label[,label...]###name:val,name:val` (reference:
+    CoreData.trainDataSplit/weightExtract/yExtract/line2FeatureMap)."""
+    info = line.strip().split(delim.x_delim)
+    weight = float(info[0])
+    labels = [float(v) for v in info[1].split(delim.y_delim)]
+    feats: List[Tuple[str, float]] = []
+    ftext = info[2].strip()
+    if ftext:
+        for f in ftext.split(delim.features_delim):
+            name, _, val = f.partition(delim.feature_name_val_delim)
+            feats.append((name.strip(), float(val)))
+    return ParsedLine(weight, labels, feats)
+
+
+def load_transform_hook(path: str) -> Callable[[bytes], List[str]]:
+    """Load the user data-transform hook: a python file defining
+    `transform(line: bytes) -> list[str]`. The reference embeds Jython for
+    this (reference: dataflow/DataUtils.java:142, bin/transform.py); here it
+    is plain Python."""
+    ns: Dict = {}
+    with open(path) as f:
+        exec(compile(f.read(), path, "exec"), ns)
+    if "transform" not in ns:
+        raise ValueError(f"{path} does not define transform(bytearray) -> [lines]")
+    return ns["transform"]
+
+
+# ---------------------------------------------------------------------------
+# Feature statistics / transform
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class FeatureStat:
+    """Running (cnt, sum, sum2, min, max) (reference: CoreData.FeatureStat:107)."""
+
+    cnt: int = 0
+    sum: float = 0.0
+    sum2: float = 0.0
+    max: float = -math.inf
+    min: float = math.inf
+
+    def update(self, v: float) -> None:
+        self.cnt += 1
+        self.sum += v
+        self.sum2 += v * v
+        if v > self.max:
+            self.max = v
+        if v < self.min:
+            self.min = v
+
+    def merge(self, o: "FeatureStat") -> None:
+        self.cnt += o.cnt
+        self.sum += o.sum
+        self.sum2 += o.sum2
+        self.max = max(self.max, o.max)
+        self.min = min(self.min, o.min)
+
+
+@dataclass
+class TransformNode:
+    """Standardization / range-scaling of one feature
+    (reference: CoreData.TransformNode:155; sidecar text format kept
+    byte-compatible so reference predictors can read it)."""
+
+    mode: str  # standardization | scale_range
+    mean: float = 0.0
+    stdvar: float = 0.0
+    max: float = 0.0
+    min: float = 0.0
+    range_max: float = 1.0
+    range_min: float = -1.0
+
+    def transform(self, val: float) -> float:
+        if self.mode == "standardization":
+            if self.stdvar < 1e-6:
+                return val
+            return (val - self.mean) / self.stdvar
+        if abs(self.max - self.min) < 1e-6:
+            return 1.0
+        return self.range_min + (self.range_max - self.range_min) * (
+            (val - self.min) / (self.max - self.min)
+        )
+
+    def __str__(self) -> str:  # sidecar line payload
+        return (
+            f"mode={self.mode}, mean={self.mean}, stdvar={self.stdvar}, "
+            f"max={self.max}, min={self.min}, rangeMax={self.range_max}, "
+            f"rangeMin={self.range_min}"
+        )
+
+    @classmethod
+    def from_string(cls, s: str) -> "TransformNode":
+        info = [kv.split("=")[1].strip() for kv in s.split(",")]
+        return cls(
+            mode=info[0].lower(),
+            mean=float(info[1]),
+            stdvar=float(info[2]),
+            max=float(info[3]),
+            min=float(info[4]),
+            range_max=float(info[5]),
+            range_min=float(info[6]),
+        )
+
+    @classmethod
+    def from_stat(
+        cls, stat: FeatureStat, mode: str, range_max: float, range_min: float
+    ) -> "TransformNode":
+        mean = stat.sum / stat.cnt
+        mean2 = stat.sum2 / stat.cnt
+        return cls(
+            mode=mode,
+            mean=mean,
+            stdvar=math.sqrt(max(mean2 - mean * mean, 0.0)),
+            max=stat.max,
+            min=stat.min,
+            range_max=range_max,
+            range_min=range_min,
+        )
+
+
+# ---------------------------------------------------------------------------
+# The dataset container
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class SparseDataset:
+    """Padded ELL sparse rows, host side (numpy), jit-ready.
+
+    idx[i, j] / val[i, j] hold the j-th (feature, value) of row i; padding
+    entries have idx=0, val=0.0 (harmless: they add 0·w[0] to scores and 0 to
+    grads). When need_bias, every row's first slot is (0, 1.0) — index 0 *is*
+    the bias feature, as in the reference dict layout
+    (reference: DataFlow.reduceFeature fName2IndexMap bias at 0).
+    """
+
+    idx: np.ndarray  # (n, width) int32
+    val: np.ndarray  # (n, width) float32
+    y: np.ndarray  # (n,) or (n, K) float32
+    weight: np.ndarray  # (n,) float32
+    n_real: int  # rows before padding
+    dim: int  # feature dimension (dict size)
+
+    @property
+    def n(self) -> int:
+        return self.idx.shape[0]
+
+    def pad_rows(self, multiple: int) -> "SparseDataset":
+        """Pad row count to a multiple (mesh divisibility). Padding rows have
+        weight 0 so every weighted reduction ignores them — the static-shape
+        replacement for the reference's ragged per-worker row counts."""
+        n = self.idx.shape[0]
+        target = (n + multiple - 1) // multiple * multiple
+        if target == n:
+            return self
+        pad = target - n
+        return dataclasses.replace(
+            self,
+            idx=np.pad(self.idx, ((0, pad), (0, 0))),
+            val=np.pad(self.val, ((0, pad), (0, 0))),
+            y=np.pad(self.y, ((0, pad),) + ((0, 0),) * (self.y.ndim - 1)),
+            weight=np.pad(self.weight, (0, pad)),
+        )
+
+
+# ---------------------------------------------------------------------------
+# The ingest driver (DataFlow equivalent)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class IngestResult:
+    train: SparseDataset
+    test: Optional[SparseDataset]
+    feature_map: Dict[str, int]  # name -> global index
+    transform_nodes: Dict[int, TransformNode] = field(default_factory=dict)
+    # global label stats (reference: CoreData.globalSync y stats)
+    y_real_stat: Optional[np.ndarray] = None
+    y_weight_stat: Optional[np.ndarray] = None
+
+
+class DataIngest:
+    """Single-host ingest (the TPU host driver replaces per-thread CoreData
+    shards: one process parses, the mesh shards rows on device). Multi-host
+    processes each parse their line-modulo shard and merge dict/stats via
+    host collectives (parallel.collectives.host_allgather_objects)."""
+
+    def __init__(
+        self,
+        params: CommonParams,
+        fs: Optional[FileSystem] = None,
+        n_labels: int = 1,
+        label_as_class_index: bool = False,
+        transform_hook: Optional[Callable[[bytes], List[str]]] = None,
+    ):
+        self.params = params
+        self.fs = fs or LocalFileSystem()
+        self.n_labels = n_labels  # K for multiclass losses, else 1
+        self.label_as_class_index = label_as_class_index
+        self.transform_hook = transform_hook
+        p = params
+        self.hash = (
+            FeatureHash(
+                p.feature.feature_hash.bucket_size,
+                p.feature.feature_hash.seed,
+                p.feature.feature_hash.feature_prefix,
+            )
+            if p.feature.feature_hash.need_feature_hash
+            else None
+        )
+        self.rng = random.Random(20170425)
+
+    # -- parsing --------------------------------------------------------
+
+    def _expand_labels(self, labels: List[float], line: str) -> List[float]:
+        K = self.n_labels
+        if K == 1:
+            return labels[:1]
+        if len(labels) == K:
+            return labels
+        if len(labels) == 1:
+            clazz = int(labels[0])
+            if clazz >= K:
+                raise ValueError(f"label must be in [0,{K-1}]: {line}")
+            out = [0.0] * K
+            out[clazz] = 1.0
+            return out
+        raise ValueError(f"label num must be {K} or 1: {line}")
+
+    def parse_rows(
+        self, lines: Iterable[str], max_error_tol: int, is_train: bool
+    ) -> List[ParsedLine]:
+        delim = self.params.data.delim
+        ys = dict(self.params.data.y_sampling)
+        rows: List[ParsedLine] = []
+        errors = 0
+        for raw in lines:
+            if not raw.strip():
+                continue
+            for line in (
+                self.transform_hook(raw.encode("utf-8")) if self.transform_hook else [raw]
+            ):
+                try:
+                    pl = parse_line(line, delim)
+                    pl.labels = self._expand_labels(pl.labels, line)
+                    if self.hash is not None:
+                        pl.feats = self.hash.hash_features(pl.feats)
+                except Exception:
+                    errors += 1
+                    if errors > max_error_tol:
+                        raise
+                    continue
+                if is_train and ys:
+                    # label-dependent subsampling with inverse-probability
+                    # weight correction (reference: CoreData.yExtract)
+                    label_idx = (
+                        pl.labels.index(1.0) if len(pl.labels) > 1 else int(pl.labels[0])
+                    )
+                    rate = ys.get(str(label_idx))
+                    if rate is not None:
+                        pl.weight *= (1.0 / rate) if rate <= 1.0 else rate
+                        if self.rng.random() > rate:
+                            continue
+                rows.append(pl)
+        return rows
+
+    # -- dict -----------------------------------------------------------
+
+    def build_feature_map(self, rows: Sequence[ParsedLine]) -> Dict[str, int]:
+        """Count -> filter(threshold) -> sorted names -> indices, bias at 0
+        (reference: DataFlow.reduceFeature:294)."""
+        p = self.params
+        counts: Dict[str, int] = {}
+        for r in rows:
+            for name, _ in r.feats:
+                counts[name] = counts.get(name, 0) + 1
+        counts = self._merge_counts(counts)
+        thr = p.feature.filter_threshold
+        names = sorted(n for n, c in counts.items() if c >= thr)
+        fmap: Dict[str, int] = {}
+        delta = 0
+        if p.model.need_bias:
+            fmap[p.model.bias_feature_name] = 0
+            delta = 1
+            if p.model.bias_feature_name in names:
+                names.remove(p.model.bias_feature_name)
+        for i, n in enumerate(names):
+            fmap[n] = i + delta
+        return fmap
+
+    def _merge_counts(self, counts: Dict[str, int]) -> Dict[str, int]:
+        """Across processes (multi-host): union-sum the count maps — the
+        allreduceMap equivalent (reference: CoreData.globalSync:628)."""
+        from ..parallel.collectives import host_allgather_objects
+
+        all_counts = host_allgather_objects(counts)
+        if len(all_counts) == 1:
+            return counts
+        merged: Dict[str, int] = {}
+        for c in all_counts:
+            for k, v in c.items():
+                merged[k] = merged.get(k, 0) + v
+        return merged
+
+    def load_feature_map(self, dict_paths: Sequence[str]) -> Dict[str, int]:
+        """reference: DataFlow.loadDict:244 — bias at 0, then dict file lines
+        in sorted-path order."""
+        p = self.params
+        fmap: Dict[str, int] = {}
+        if p.model.need_bias:
+            fmap[p.model.bias_feature_name] = 0
+        for path in sorted(self.fs.recur_get_paths(dict_paths)):
+            with self.fs.open(path) as f:
+                for line in f:
+                    name = line.strip()
+                    if name and name not in fmap:
+                        fmap[name] = len(fmap)
+        return fmap
+
+    # -- transform ------------------------------------------------------
+
+    def compute_transform_nodes(
+        self, rows: Sequence[ParsedLine], fmap: Dict[str, int]
+    ) -> Dict[int, TransformNode]:
+        p = self.params
+        t = p.feature.transform
+        if not t.switch_on:
+            return {}
+        stats: Dict[str, FeatureStat] = {}
+        for r in rows:
+            for name, v in r.feats:
+                s = stats.get(name)
+                if s is None:
+                    stats[name] = s = FeatureStat()
+                s.update(v)
+        # multi-host merge
+        from ..parallel.collectives import host_allgather_objects
+
+        all_stats = host_allgather_objects(stats)
+        if len(all_stats) > 1:
+            merged: Dict[str, FeatureStat] = {}
+            for st in all_stats:
+                for k, v in st.items():
+                    if k in merged:
+                        merged[k].merge(v)
+                    else:
+                        merged[k] = dataclasses.replace(v)
+            stats = merged
+
+        include, exclude = set(t.include_features), set(t.exclude_features)
+        names = set(fmap) - {p.model.bias_feature_name}
+        chosen = include or (names - exclude if exclude else names)
+        nodes: Dict[int, TransformNode] = {}
+        for name in chosen:
+            if name in stats and name in fmap:
+                nodes[fmap[name]] = TransformNode.from_stat(
+                    stats[name], t.mode, t.scale_max, t.scale_min
+                )
+        return nodes
+
+    def write_transform_sidecar(
+        self, nodes: Dict[int, TransformNode], fmap: Dict[str, int]
+    ) -> None:
+        """`<model>_feature_transform_stat` sidecar, reference text format
+        (reference: DataFlow.reduceFeature stat writer, FEATURE_TRANSFORM_STAT)."""
+        if not nodes:
+            return
+        inv = {i: n for n, i in fmap.items()}
+        path = self.params.model.data_path + "_feature_transform_stat"
+        with self.fs.open(path, "w") as f:
+            for i, node in sorted(nodes.items()):
+                f.write(f"{inv[i]}###{node}\n")
+
+    def load_transform_sidecar(self, fmap: Dict[str, int]) -> Dict[int, TransformNode]:
+        path = self.params.model.data_path + "_feature_transform_stat"
+        nodes: Dict[int, TransformNode] = {}
+        if not self.fs.exists(path):
+            return nodes
+        with self.fs.open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                name, _, payload = line.partition("###")
+                if name in fmap:
+                    nodes[fmap[name]] = TransformNode.from_string(payload)
+        return nodes
+
+    # -- materialization -------------------------------------------------
+
+    def to_dataset(
+        self,
+        rows: Sequence[ParsedLine],
+        fmap: Dict[str, int],
+        nodes: Optional[Dict[int, TransformNode]] = None,
+    ) -> SparseDataset:
+        p = self.params
+        nodes = nodes or {}
+        need_bias = p.model.need_bias
+        n = len(rows)
+        K = self.n_labels
+        mapped: List[List[Tuple[int, float]]] = []
+        width = 1 if need_bias else 0
+        for r in rows:
+            entries: List[Tuple[int, float]] = []
+            if need_bias:
+                entries.append((0, 1.0))
+            for name, v in r.feats:
+                gi = fmap.get(name)
+                if gi is None:
+                    continue  # filtered feature — dropped like handleLocalIdx
+                node = nodes.get(gi)
+                entries.append((gi, node.transform(v) if node else v))
+            mapped.append(entries)
+            width = max(width, len(entries))
+        width = max(width, 1)
+        idx = np.zeros((n, width), np.int32)
+        val = np.zeros((n, width), np.float32)
+        for i, entries in enumerate(mapped):
+            for j, (gi, v) in enumerate(entries):
+                idx[i, j] = gi
+                val[i, j] = v
+        y = np.asarray(
+            [r.labels for r in rows], np.float32
+        ).reshape((n, K)) if K > 1 else np.asarray([r.labels[0] for r in rows], np.float32)
+        weight = np.asarray([r.weight for r in rows], np.float32)
+        return SparseDataset(idx, val, y, weight, n_real=n, dim=len(fmap))
+
+    # -- the whole flow ---------------------------------------------------
+
+    def load(self) -> IngestResult:
+        """The loadFlow equivalent (reference: dataflow/DataFlow.java:468)."""
+        p = self.params
+        import jax
+
+        n_proc = jax.process_count()
+        proc = jax.process_index()
+
+        def read(paths: Sequence[str]) -> Iterator[str]:
+            if p.data.assigned or n_proc == 1:
+                return self.fs.read_lines(paths)
+            if p.data.unassigned_mode == "files_avg":
+                files = sorted(self.fs.recur_get_paths(paths))
+                share = files[proc::n_proc]
+                return self.fs.read_lines(share)
+            return self.fs.select_read_lines(paths, n_proc, proc)
+
+        train_rows = self.parse_rows(
+            read(p.data.train_paths), p.data.train_max_error_tol, is_train=True
+        )
+
+        # dict: load when need_dict or continue_train with an existing sidecar
+        model_dict_path = p.model.data_path + "_dict"
+        if p.loss.just_evaluate and self.fs.exists(model_dict_path):
+            fmap = self.load_feature_map([model_dict_path])
+        elif p.model.need_dict and p.model.dict_path:
+            fmap = self.load_feature_map([p.model.dict_path])
+        elif p.model.continue_train and self.fs.exists(model_dict_path):
+            fmap = self.load_feature_map([model_dict_path])
+        else:
+            fmap = self.build_feature_map(train_rows)
+
+        nodes = self.compute_transform_nodes(train_rows, fmap)
+        if nodes:
+            self.write_transform_sidecar(nodes, fmap)
+
+        train = self.to_dataset(train_rows, fmap, nodes)
+        test = None
+        if p.data.test_paths:
+            test_rows = self.parse_rows(
+                read(p.data.test_paths), p.data.test_max_error_tol, is_train=False
+            )
+            test = self.to_dataset(test_rows, fmap, nodes)
+
+        # global label stats (reference: CoreData.globalSync y stats)
+        K = max(self.n_labels, 2)
+        y_real = np.zeros(K, np.int64)
+        y_weight = np.zeros(K, np.float64)
+        for r in train_rows:
+            li = r.labels.index(1.0) if len(r.labels) > 1 else int(r.labels[0])
+            if 0 <= li < K:
+                y_real[li] += 1
+                y_weight[li] += r.weight
+        return IngestResult(
+            train=train,
+            test=test,
+            feature_map=fmap,
+            transform_nodes=nodes,
+            y_real_stat=y_real,
+            y_weight_stat=y_weight,
+        )

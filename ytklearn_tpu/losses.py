@@ -1,0 +1,578 @@
+"""Loss/activation library — the TPU rebuild of the reference `loss/` package.
+
+Every loss is a set of *pure, elementwise jnp functions* designed to be
+vmapped/jitted over sample batches (the reference instead calls scalar
+virtual methods per sample inside the per-thread loops —
+reference: loss/ILossFunction.java:47, loss/LossFunctions.java:31-79).
+
+Scalar-score losses expose:
+    loss(score, label)              objective per sample
+    predict(score)                  score -> prediction
+    pred2score(pred)                inverse of predict
+    first_derivative(score, label)  dL/dscore
+    second_derivative(score, label) d2L/dscore2
+    grad_hess(pred, label)          (g, h) from *prediction* — the GBDT fast
+                                    path (reference: ILossFunction.getDerivativeFast)
+
+Multiclass losses (softmax / hsoftmax / multiclass_*hinge) operate on the
+trailing axis K:
+    loss(scores[..., K], labels[..., K])      -> [...]
+    predict(scores[..., K])                   -> [..., K]
+    first_derivative(scores, labels)          -> [..., K]
+    grad_hess(pred[..., K], labels[..., K])   -> (g, h) each [..., K]
+
+All functions accept arrays and broadcast; labels for binary losses are in
+{0,1} (margin losses internally map to ±1 exactly as the reference does).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+# Clamp constants mirrored from the reference.
+_POISSON_MAX_EXP = 30.0  # reference: loss/PoissonFunction.java MAX_EXP
+_EXP_MAX_EXP = 8.0  # reference: loss/ExponentialFunction.java MAX_EXP
+
+
+def _softplus(x):
+    """Numerically-stable log(1+exp(x)) (the reference branches on sign;
+    jnp.logaddexp is the branch-free equivalent)."""
+    return jnp.logaddexp(0.0, x)
+
+
+class LossFunction:
+    """Base: scalar-score loss. Subclasses override the static math."""
+
+    name = "base"
+    is_multiclass = False
+    # reference: loss/LossFunctions.java:79 pureClassification
+    pure_classification = False
+
+    def loss(self, score, label):
+        raise NotImplementedError
+
+    def predict(self, score):
+        return score
+
+    def pred2score(self, pred):
+        return pred
+
+    def first_derivative(self, score, label):
+        raise NotImplementedError
+
+    def second_derivative(self, score, label):
+        return jnp.ones_like(jnp.asarray(score, jnp.float32))
+
+    def grad_hess(self, pred, label):
+        """(g,h) wrt score, given *prediction* (GBDT fast path)."""
+        score = self.pred2score(pred)
+        return self.first_derivative(score, label), self.second_derivative(score, label)
+
+    def check_label(self, y) -> bool:
+        return True
+
+
+class Sigmoid(LossFunction):
+    """Logistic loss (reference: loss/SigmoidFunction.java)."""
+
+    name = "sigmoid"
+    pure_classification = True
+
+    def __init__(self, zmax: float = 0.0):
+        # sigmoid_zmax clamps |g/h| in the GBDT fast path
+        # (reference: SigmoidFunction.getDerivativeFast + setParam).
+        self.zmax = float(zmax)
+
+    def loss(self, score, label):
+        return _softplus(score) - score * label
+
+    def predict(self, score):
+        return jax.nn.sigmoid(score)
+
+    def pred2score(self, pred):
+        return -jnp.log(1.0 / pred - 1.0)
+
+    def first_derivative(self, score, label):
+        return jax.nn.sigmoid(score) - label
+
+    def second_derivative(self, score, label):
+        p = jax.nn.sigmoid(score)
+        return p * (1.0 - p)
+
+    def grad_hess(self, pred, label):
+        g = pred - label
+        h = pred * (1.0 - pred)
+        if self.zmax > 0.0:
+            # cap the implied newton step z=-g/h at ±zmax by inflating h
+            z = jnp.where(h != 0.0, -g / h, 0.0)
+            h = jnp.where(z > self.zmax, -(g / self.zmax), h)
+            h = jnp.where(z < -self.zmax, g / self.zmax, h)
+        return g, h
+
+    def check_label(self, y) -> bool:
+        return bool(jnp.all((y >= 0.0) & (y <= 1.0)))
+
+
+class L2(LossFunction):
+    """Squared error (reference: loss/L2Function.java)."""
+
+    name = "l2"
+
+    def loss(self, score, label):
+        d = label - score
+        return 0.5 * d * d
+
+    def first_derivative(self, score, label):
+        return score - label
+
+    def grad_hess(self, pred, label):
+        return pred - label, jnp.ones_like(pred)
+
+
+class L1(LossFunction):
+    """Absolute error; 2nd derivative reported as 1.0 like the reference so
+    L-BFGS curvature stays positive (reference: loss/L1Function.java)."""
+
+    name = "l1"
+
+    def loss(self, score, label):
+        return jnp.abs(label - score)
+
+    def first_derivative(self, score, label):
+        return jnp.sign(score - label)
+
+    def grad_hess(self, pred, label):
+        return jnp.sign(pred - label), jnp.ones_like(pred)
+
+
+class Huber(LossFunction):
+    """Huber loss with threshold delta (reference: loss/HuberFunction.java)."""
+
+    name = "huber"
+
+    def __init__(self, delta: float = 0.5):
+        self.delta = float(delta)
+
+    def loss(self, score, label):
+        a = jnp.abs(score - label)
+        return jnp.where(
+            a <= self.delta, 0.5 * a * a, self.delta * (a - 0.5 * self.delta)
+        )
+
+    def first_derivative(self, score, label):
+        a = score - label
+        return jnp.where(jnp.abs(a) <= self.delta, a, jnp.sign(a) * self.delta)
+
+    def second_derivative(self, score, label):
+        return jnp.zeros_like(jnp.asarray(score, jnp.float32))
+
+
+class Poisson(LossFunction):
+    """Poisson regression, score = log(rate); the log(y!) constant term is
+    dropped (the reference adds it via a lookup table, which shifts the loss
+    by a constant and never affects gradients — reference:
+    loss/PoissonFunction.java logyfunc)."""
+
+    name = "poisson"
+
+    def loss(self, score, label):
+        s = jnp.minimum(score, _POISSON_MAX_EXP)
+        lbl = jnp.maximum(label, 0.0)
+        # lgamma(y+1) = log(y!) — exact counterpart of the reference's table.
+        return -label * score + jnp.exp(s) + jax.lax.lgamma(lbl + 1.0)
+
+    def predict(self, score):
+        return jnp.exp(jnp.minimum(score, _POISSON_MAX_EXP))
+
+    def pred2score(self, pred):
+        return jnp.log(pred)
+
+    def first_derivative(self, score, label):
+        return jnp.exp(jnp.minimum(score, _POISSON_MAX_EXP)) - label
+
+    def second_derivative(self, score, label):
+        return jnp.exp(jnp.minimum(score, _POISSON_MAX_EXP))
+
+    def grad_hess(self, pred, label):
+        return pred - label, pred
+
+    def check_label(self, y) -> bool:
+        return bool(jnp.all(y >= 0.0))
+
+
+class MAPE(LossFunction):
+    """reference: loss/MAPEFunction.java."""
+
+    name = "mape"
+
+    def loss(self, score, label):
+        return jnp.abs((label - score) / label)
+
+    def first_derivative(self, score, label):
+        return jnp.sign(score - label) / label
+
+
+class InvMAPE(LossFunction):
+    """reference: loss/InvMAPEFunction.java."""
+
+    name = "inv_mape"
+
+    def loss(self, score, label):
+        return jnp.abs((label - score) / score)
+
+    def first_derivative(self, score, label):
+        return jnp.sign((score - label) / score) * label / (score * score)
+
+
+class SMAPE(LossFunction):
+    """reference: loss/SMAPEFunction.java."""
+
+    name = "smape"
+
+    def loss(self, score, label):
+        return jnp.abs(score - label) / ((label + jnp.abs(score)) / 2.0)
+
+    def first_derivative(self, score, label):
+        deno = (label + jnp.abs(score)) / 2.0
+        return (
+            jnp.sign(score - label) * deno
+            - 0.5 * jnp.sign(score) * jnp.abs(score - label)
+        ) / (deno * deno)
+
+
+class Hinge(LossFunction):
+    """reference: loss/HingeFunction.java. Labels in {0,1} mapped to ±1."""
+
+    name = "hinge"
+    pure_classification = True
+
+    def loss(self, score, label):
+        return jnp.maximum(0.0, 1.0 - (2.0 * label - 1.0) * score)
+
+    def first_derivative(self, score, label):
+        ylab = 2.0 * label - 1.0
+        return jnp.where(ylab * score < 1.0, -ylab, 0.0)
+
+    def second_derivative(self, score, label):
+        return jnp.zeros_like(jnp.asarray(score, jnp.float32))
+
+
+class L2Hinge(LossFunction):
+    """reference: loss/L2HingeFunction.java."""
+
+    name = "l2_hinge"
+    pure_classification = True
+
+    def loss(self, score, label):
+        m = jnp.maximum(0.0, 1.0 - (2.0 * label - 1.0) * score)
+        return 0.5 * m * m
+
+    def first_derivative(self, score, label):
+        ylab = 2.0 * label - 1.0
+        z = ylab * score
+        return jnp.where(z <= 1.0, (z - 1.0) * ylab, 0.0)
+
+
+class SmoothHinge(LossFunction):
+    """reference: loss/SmoothHingeFunction.java."""
+
+    name = "smooth_hinge"
+    pure_classification = True
+
+    def loss(self, score, label):
+        z = (2.0 * label - 1.0) * score
+        return jnp.where(
+            z <= 0.0,
+            0.5 - z,
+            jnp.where(z < 1.0, 0.5 * (1.0 - z) * (1.0 - z), 0.0),
+        )
+
+    def first_derivative(self, score, label):
+        ylab = 2.0 * label - 1.0
+        z = ylab * score
+        return jnp.where(z <= 0.0, -ylab, jnp.where(z < 1.0, -ylab * (1.0 - z), 0.0))
+
+    def second_derivative(self, score, label):
+        ylab = 2.0 * label - 1.0
+        z = ylab * score
+        return jnp.where((z > 0.0) & (z < 1.0), ylab * ylab, 0.0)
+
+
+class Exponential(LossFunction):
+    """AdaBoost-style exponential loss, exp clamp at 8
+    (reference: loss/ExponentialFunction.java)."""
+
+    name = "exponential"
+    pure_classification = True
+
+    def loss(self, score, label):
+        ylab = 2.0 * label - 1.0
+        return jnp.exp(jnp.minimum(-score * ylab, _EXP_MAX_EXP))
+
+    def first_derivative(self, score, label):
+        ylab = 2.0 * label - 1.0
+        return -ylab * jnp.exp(jnp.minimum(-score * ylab, _EXP_MAX_EXP))
+
+    def second_derivative(self, score, label):
+        ylab = 2.0 * label - 1.0
+        return ylab * ylab * jnp.exp(jnp.minimum(-score * ylab, _EXP_MAX_EXP))
+
+
+# ---------------------------------------------------------------------------
+# Multiclass losses — operate on trailing axis K
+# ---------------------------------------------------------------------------
+
+
+class MulticlassLoss(LossFunction):
+    is_multiclass = True
+
+    def check_label(self, y) -> bool:
+        # one-hot rows must sum to ~1 (reference: SoftmaxFunction.checkLabel)
+        return bool(jnp.all(jnp.abs(jnp.sum(y, axis=-1) - 1.0) < 1e-3))
+
+
+class Softmax(MulticlassLoss):
+    """Softmax cross-entropy (reference: loss/SoftmaxFunction.java)."""
+
+    name = "softmax"
+    pure_classification = True
+
+    def loss(self, scores, labels):
+        m = jnp.max(scores, axis=-1, keepdims=True)
+        shifted = scores - m
+        return jnp.log(jnp.sum(jnp.exp(shifted), axis=-1)) - jnp.sum(
+            shifted * labels, axis=-1
+        )
+
+    def predict(self, scores):
+        return jax.nn.softmax(scores, axis=-1)
+
+    def first_derivative(self, scores, labels):
+        return jax.nn.softmax(scores, axis=-1) - labels
+
+    def second_derivative(self, scores, labels):
+        p = jax.nn.softmax(scores, axis=-1)
+        return 2.0 * p * (1.0 - p)
+
+    def grad_hess(self, pred, labels):
+        # reference: SoftmaxFunction.getDerivativeFast — h = 2 p (1-p)
+        return pred - labels, 2.0 * (pred * (1.0 - pred))
+
+
+class HSoftmax(MulticlassLoss):
+    """Hierarchical softmax over a complete binary tree of K leaves with
+    K-1 internal sigmoid gates (reference: loss/HSoftmaxFunction.java).
+
+    Scores are the K-1 internal-node logits in heap order (node 1 = root,
+    node j's children are 2j, 2j+1; leaves are nodes K..2K-1). Requires K a
+    power of two for a complete tree, matching the reference's heap layout.
+    """
+
+    name = "hsoftmax"
+    pure_classification = True
+
+    def _mu(self, labels):
+        """Bottom-up subtree label mass: mu[j] for heap nodes 1..2K-1."""
+        K = labels.shape[-1]
+        # mu laid out 1-indexed in a (..., 2K) buffer; mu[K+i] = labels[i]
+        mu = jnp.zeros(labels.shape[:-1] + (2 * K,), labels.dtype)
+        mu = mu.at[..., K:].set(labels)
+        for j in range(K - 1, 0, -1):
+            mu = mu.at[..., j].set(mu[..., 2 * j] + mu[..., 2 * j + 1])
+        return mu
+
+    def loss(self, scores, labels):
+        K = labels.shape[-1]
+        mu = self._mu(labels)
+        # internal node k (1-indexed, score scores[k-1]): children 2k (left,
+        # goes with sigmoid(score)) and 2k+1; loss contribution =
+        # mu_parent * softplus(s) - mu_left * s  (rearranged stable form)
+        s = scores  # (..., K-1)
+        mu_parent = mu[..., 1:K]
+        mu_left = mu[..., 2 : 2 * K : 2]
+        return jnp.sum(mu_parent * _softplus(s) - mu_left * s, axis=-1)
+
+    def predict(self, scores):
+        K = scores.shape[-1] + 1
+        g = jax.nn.sigmoid(scores)  # P(left) at internal node 1..K-1
+        # leaf probability: product of gate probs along root->leaf path
+        probs = jnp.ones(scores.shape[:-1] + (1,), scores.dtype)
+        # iterative doubling down the heap levels
+        level = probs  # nodes at current level, size 2^d
+        for _ in range(int(math.log2(K))):
+            n = level.shape[-1]
+            gates = jax.lax.dynamic_slice_in_dim(g, n - 1, n, axis=-1)
+            left = level * gates
+            right = level * (1.0 - gates)
+            level = jnp.stack([left, right], axis=-1).reshape(
+                scores.shape[:-1] + (2 * n,)
+            )
+        return level
+
+    def first_derivative(self, scores, labels):
+        K = labels.shape[-1]
+        mu = self._mu(labels)
+        g = jax.nn.sigmoid(scores)
+        mu_parent = mu[..., 1:K]
+        mu_left = mu[..., 2 : 2 * K : 2]
+        return g * mu_parent - mu_left
+
+    def second_derivative(self, scores, labels):
+        K = labels.shape[-1]
+        mu = self._mu(labels)
+        g = jax.nn.sigmoid(scores)
+        return g * (1.0 - g) * mu[..., 1:K]
+
+
+class _MulticlassMarginLoss(MulticlassLoss):
+    """Shared scaffolding for the three multiclass hinge variants
+    (reference: loss/MulticlassHingeFunction.java and friends): per-class
+    margin terms vs the target class, with the target-class gradient set to
+    -(sum of others) when the target is not the last class — replicating the
+    reference's exact (asymmetric) convention, including *not* fixing the
+    target component when target == K-1."""
+
+    def _margin_terms(self, diff):
+        raise NotImplementedError  # per-class loss term from diff = s_j - s_t
+
+    def _margin_grad(self, diff):
+        raise NotImplementedError
+
+    def _extra(self) -> float:
+        raise NotImplementedError  # constant subtracted once per sample
+
+    def predict(self, scores):
+        return scores
+
+    def loss(self, scores, labels):
+        st = jnp.sum(scores * labels, axis=-1, keepdims=True)
+        return jnp.sum(self._margin_terms(scores - st), axis=-1) - self._extra()
+
+    def first_derivative(self, scores, labels):
+        st = jnp.sum(scores * labels, axis=-1, keepdims=True)
+        d = self._margin_grad(scores - st)
+        total = jnp.sum(d, axis=-1, keepdims=True)
+        target_is_last = labels[..., -1:] == 1.0
+        fixed = jnp.where(labels == 1.0, -total + 1.0, d)
+        return jnp.where(target_is_last, d, fixed)
+
+
+class MulticlassHinge(_MulticlassMarginLoss):
+    name = "multiclass_hinge"
+    pure_classification = True
+
+    def _margin_terms(self, diff):
+        return jnp.maximum(0.0, diff + 1.0)
+
+    def _margin_grad(self, diff):
+        return jnp.where(diff + 1.0 > 0.0, 1.0, 0.0)
+
+    def _extra(self) -> float:
+        return 1.0
+
+
+class MulticlassL2Hinge(_MulticlassMarginLoss):
+    name = "multiclass_l2_hinge"
+    pure_classification = True
+
+    def _margin_terms(self, diff):
+        m = jnp.maximum(0.0, diff + 1.0)
+        return 0.5 * m * m
+
+    def _margin_grad(self, diff):
+        return jnp.maximum(0.0, diff + 1.0)
+
+    def _extra(self) -> float:
+        return 0.5
+
+    def loss(self, scores, labels):
+        # reference computes (sum m^2 - 1) * 0.5
+        st = jnp.sum(scores * labels, axis=-1, keepdims=True)
+        m = jnp.maximum(0.0, scores - st + 1.0)
+        return 0.5 * (jnp.sum(m * m, axis=-1) - 1.0)
+
+
+class MulticlassSmoothHinge(_MulticlassMarginLoss):
+    name = "multiclass_smooth_hinge"
+    pure_classification = True
+
+    def _margin_terms(self, diff):
+        return jnp.where(
+            diff >= 0.0,
+            diff + 0.5,
+            jnp.where(diff < -1.0, 0.0, 0.5 * (1.0 + diff) * (1.0 + diff)),
+        )
+
+    def _margin_grad(self, diff):
+        return jnp.where(
+            diff >= 0.0, 1.0, jnp.where(diff < -1.0, 0.0, 1.0 + diff)
+        )
+
+    def _extra(self) -> float:
+        return 0.5
+
+
+# ---------------------------------------------------------------------------
+# Factory (reference: loss/LossFunctions.java:31-79)
+# ---------------------------------------------------------------------------
+
+_PURE_CLASSIFICATION = {
+    "sigmoid", "softmax", "hinge", "smooth_hinge", "l2_hinge",
+    "multiclass_l2_hinge", "exponential", "multiclass_hinge",
+    "multiclass_smooth_hinge", "hsoftmax",
+}
+
+
+def create_loss(name: str, params: Optional[dict] = None) -> LossFunction:
+    """name -> LossFunction; supports `huber@delta` (the reference intends a
+    delta suffix — its factory splits on '@' — and defaults to 0.5), plus the
+    *_cross_entropy aliases."""
+    base, _, arg = str(name).lower().partition("@")
+    params = params or {}
+    if base in ("sigmoid", "sigmoid_cross_entropy"):
+        return Sigmoid(zmax=float(params.get("sigmoid_zmax", 0.0)))
+    if base == "l2":
+        return L2()
+    if base == "l1":
+        return L1()
+    if base == "huber":
+        return Huber(delta=float(arg) if arg else 0.5)
+    if base == "poisson":
+        return Poisson()
+    if base == "mape":
+        return MAPE()
+    if base == "inv_mape":
+        return InvMAPE()
+    if base == "smape":
+        return SMAPE()
+    if base == "hinge":
+        return Hinge()
+    if base == "l2_hinge":
+        return L2Hinge()
+    if base == "smooth_hinge":
+        return SmoothHinge()
+    if base == "exponential":
+        return Exponential()
+    if base in ("softmax", "softmax_cross_entropy"):
+        return Softmax()
+    if base in ("hsoftmax", "hsoftmax_cross_entropy"):
+        return HSoftmax()
+    if base == "multiclass_hinge":
+        return MulticlassHinge()
+    if base == "multiclass_l2_hinge":
+        return MulticlassL2Hinge()
+    if base == "multiclass_smooth_hinge":
+        return MulticlassSmoothHinge()
+    raise ValueError(f"unsupported loss function: {name!r}")
+
+
+def pure_classification(name: str) -> bool:
+    """reference: loss/LossFunctions.java:79."""
+    base = str(name).lower().partition("@")[0]
+    if base.endswith("_cross_entropy"):
+        base = base[: -len("_cross_entropy")]
+    return base in _PURE_CLASSIFICATION
