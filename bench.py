@@ -1,0 +1,81 @@
+"""Single-chip TPU benchmark. Prints ONE JSON line:
+{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Headline (until the GBDT stack lands): full L-BFGS iterations/sec for the
+linear+sigmoid kernel on synthetic dense data (4M rows x 256 features, the
+MXU matmul path) — each iteration = line-search trials x (fused Xv + loss +
+XTv grad) as one XLA program, exactly what drives every convex family.
+
+vs_baseline: the reference publishes no linear-model numbers (BASELINE.md
+covers GBDT only), so the comparator is an engineering estimate of the
+reference's Java path on its benchmark hardware (16-thread Xeon E5-2640v3):
+the dense Xv/XTv loops stream ~2 GB per pass at ~10 GB/s effective
+(java float[] + per-sample virtual loss calls), ~4 passes per iteration
+=> ~1.2 iter/s on 4M x 256. Will be replaced by the published GBDT
+trees/sec baseline (0.88 trees/s, docs/gbdt_experiments.md) once the GBDT
+stack is benchable.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from ytklearn_tpu.losses import create_loss
+    from ytklearn_tpu.optimize import LBFGSConfig, minimize_lbfgs
+
+    n, dim = 4_000_000, 256
+    rng = np.random.RandomState(0)
+    X_np = rng.randn(n, dim).astype(np.float32)
+    w_true = (rng.randn(dim) * 0.3).astype(np.float32)
+    y_np = (X_np @ w_true + 0.5 * rng.randn(n) > 0).astype(np.float32)
+
+    X = jax.device_put(X_np)
+    y = jax.device_put(y_np)
+    weight = jnp.ones((n,), jnp.float32)
+    loss = create_loss("sigmoid")
+
+    def pure_loss(w, X, y, weight):
+        return jnp.sum(weight * loss.loss(X @ w, y))
+
+    def run(iters):
+        c = LBFGSConfig(max_iter=iters, m=8, eps=0.0, mode="wolfe")
+        return minimize_lbfgs(
+            pure_loss,
+            jnp.zeros(dim, jnp.float32),
+            c,
+            batch=(X, y, weight),
+            g_weight=float(n),
+        )
+
+    run(1)  # compile (programs are cached by (loss_fn, config) -> reused below)
+    run(1)  # warm
+    t0 = time.perf_counter()
+    n_iters = 20
+    res = run(n_iters)
+    dt = time.perf_counter() - t0
+    iters_per_sec = n_iters / dt
+    assert np.isfinite(res.loss)
+
+    ref_estimate = 1.2  # see module docstring
+    print(
+        json.dumps(
+            {
+                "metric": "linear_lbfgs_iter_per_sec_4Mx256",
+                "value": round(iters_per_sec, 3),
+                "unit": "iter/s",
+                "vs_baseline": round(iters_per_sec / ref_estimate, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,110 @@
+"""Eval metric tests: bucketed AUC vs exact pair-count AUC, pointwise, confusion."""
+
+import numpy as np
+import pytest
+
+from ytklearn_tpu.eval import EvalSet, auc, confusion_matrix, pointwise
+
+
+def _exact_auc(pred, y, w=None):
+    """O(n^2)-free exact AUC via rank statistic (ties get half credit)."""
+    pred, y = np.asarray(pred, np.float64), np.asarray(y)
+    w = np.ones_like(pred) if w is None else np.asarray(w, np.float64)
+    pos, neg = y == 1, y != 1
+    # weighted pair count by sorting
+    order = np.argsort(pred, kind="stable")
+    p, yy, ww = pred[order], y[order], w[order]
+    # count for each neg, positives ranked strictly above + half ties
+    total = 0.0
+    pos_w_above = np.sum(ww[yy == 1])
+    i = 0
+    n = len(p)
+    while i < n:
+        j = i
+        tie_pos = tie_neg = 0.0
+        while j < n and p[j] == p[i]:
+            if yy[j] == 1:
+                tie_pos += ww[j]
+            else:
+                tie_neg += ww[j]
+            j += 1
+        pos_w_above -= tie_pos
+        total += tie_neg * (pos_w_above + 0.5 * tie_pos)
+        i = j
+    return total / (np.sum(w[pos]) * np.sum(w[neg]))
+
+
+def test_auc_matches_exact_within_bucket_tolerance():
+    rng = np.random.RandomState(0)
+    n = 5000
+    y = (rng.rand(n) < 0.3).astype(np.float32)
+    # informative predictions
+    pred = np.clip(0.3 * y + 0.35 + 0.25 * rng.randn(n), 0.0, 1.0).astype(np.float32)
+    w_auc, uw_auc = auc(pred, y)
+    exact = _exact_auc(pred, y)
+    assert abs(float(w_auc) - exact) < 1e-3  # 1e-5 bucketing + clip ties
+    assert abs(float(uw_auc) - exact) < 1e-3
+
+
+def test_auc_weighted_vs_unweighted_differ():
+    y = np.array([1, 1, 0, 0], np.float32)
+    pred = np.array([0.9, 0.4, 0.6, 0.1], np.float32)
+    w = np.array([1.0, 5.0, 5.0, 1.0], np.float32)
+    wa, ua = auc(pred, y, w)
+    np.testing.assert_allclose(float(ua), _exact_auc(pred, y), atol=1e-4)
+    np.testing.assert_allclose(float(wa), _exact_auc(pred, y, w), atol=1e-4)
+
+
+def test_auc_perfect_and_random():
+    y = np.array([0, 0, 1, 1], np.float32)
+    assert float(auc(np.array([0.1, 0.2, 0.8, 0.9], np.float32), y)[0]) == pytest.approx(1.0)
+    assert float(auc(np.array([0.9, 0.8, 0.2, 0.1], np.float32), y)[0]) == pytest.approx(0.0)
+
+
+def test_auc_padding_rows_ignored():
+    y = np.array([0, 1, 0, 0], np.float32)
+    pred = np.array([0.2, 0.8, 0.99, 0.99], np.float32)
+    w = np.array([1.0, 1.0, 0.0, 0.0], np.float32)  # last two are padding
+    wa, ua = auc(pred, y, w)
+    assert float(wa) == pytest.approx(1.0)
+    assert float(ua) == pytest.approx(1.0)  # unweighted uses the !=0 mask
+
+
+def test_pointwise_metrics():
+    y = np.array([1.0, 2.0, 3.0], np.float32)
+    p = np.array([1.5, 2.0, 2.0], np.float32)
+    np.testing.assert_allclose(
+        float(pointwise(p, y, kind="rmse")), np.sqrt((0.25 + 0 + 1) / 3), rtol=1e-6
+    )
+    np.testing.assert_allclose(float(pointwise(p, y, kind="mae")), 0.5, rtol=1e-6)
+    np.testing.assert_allclose(
+        float(pointwise(p, y, kind="mape")), (0.5 / 1 + 0 + 1.0 / 3) / 3, rtol=1e-6
+    )
+
+
+def test_confusion_matrix_binary_and_multiclass():
+    y = np.array([1, 0, 1, 0], np.float32)
+    p = np.array([0.9, 0.2, 0.3, 0.7], np.float32)
+    out = confusion_matrix(p, y, threshold=0.5)
+    m = np.asarray(out["matrix"])
+    # true 1: pred 1 (0.9), pred 0 (0.3); true 0: pred 0 (0.2), pred 1 (0.7)
+    np.testing.assert_allclose(m, [[1, 1], [1, 1]])
+    assert float(out["accuracy"]) == pytest.approx(0.5)
+
+    K = 3
+    ym = np.eye(K, dtype=np.float32)[[0, 1, 2, 2]]
+    pm = np.eye(K, dtype=np.float32)[[0, 1, 1, 2]] * 0.9 + 0.05
+    outm = confusion_matrix(pm, ym, K=K)
+    mm = np.asarray(outm["matrix"])
+    np.testing.assert_allclose(mm, [[1, 0, 0], [0, 1, 0], [0, 1, 1]])
+    assert float(outm["accuracy"]) == pytest.approx(0.75)
+
+
+def test_evalset_parses_metric_args():
+    es = EvalSet(["auc", "auc@1000", "rmse", "mae", "confusion_matrix@0.7"])
+    y = (np.random.RandomState(1).rand(200) < 0.5).astype(np.float32)
+    pred = np.clip(0.5 * y + 0.25 + 0.2 * np.random.RandomState(2).randn(200), 0, 1).astype(np.float32)
+    res = es.evaluate(pred, y)
+    assert set(res) == {"auc", "auc@1000", "rmse", "mae", "confusion_matrix@0.7"}
+    assert abs(res["auc"] - res["auc@1000"]) < 5e-3
+    assert "auc" in es.format(res, prefix="train")

@@ -1,0 +1,141 @@
+"""End-to-end linear+sigmoid L-BFGS training on the agaricus demo data —
+the minimum slice of SURVEY §7 stage 4, including the 8-device mesh path,
+model dump/load round-trip, and continue_train resume."""
+
+import numpy as np
+import pytest
+
+from ytklearn_tpu.config import hocon
+from ytklearn_tpu.config.params import CommonParams
+from ytklearn_tpu.io.reader import DataIngest
+from ytklearn_tpu.train import HoagTrainer
+
+REF = "/root/reference"
+LINEAR_CONF = f"{REF}/demo/linear/binary_classification/linear.conf"
+
+
+def _params(tmp_path, **over):
+    cfg = hocon.load(LINEAR_CONF)
+    cfg = hocon.set_path(
+        cfg, "data.train.data_path", f"{REF}/demo/data/ytklearn/agaricus.train.ytklearn"
+    )
+    cfg = hocon.set_path(
+        cfg, "data.test.data_path", f"{REF}/demo/data/ytklearn/agaricus.test.ytklearn"
+    )
+    cfg = hocon.set_path(cfg, "model.data_path", str(tmp_path / "lr.model"))
+    for k, v in over.items():
+        cfg = hocon.set_path(cfg, k, v)
+    return CommonParams.from_config(cfg)
+
+
+@pytest.fixture(scope="module")
+def agaricus_result(tmp_path_factory, mesh8):
+    tmp = tmp_path_factory.mktemp("linear")
+    p = _params(tmp)
+    res = HoagTrainer(p, "linear", mesh=mesh8).train()
+    return p, res, tmp
+
+
+def test_loss_decreases_and_converges(agaricus_result):
+    _, res, _ = agaricus_result
+    losses = [h["avg_loss"] for h in res.history]
+    assert losses[0] == pytest.approx(np.log(2.0), rel=1e-5)  # iter 0: w=0
+    assert losses[1] < np.log(2.0)  # below chance after 1 iteration
+    # overall monotone-ish decrease, large total reduction
+    assert losses[-1] < 0.02  # agaricus is separable; reference LR -> ~0 loss
+    assert res.n_iter >= 5
+    # weighted-sum bookkeeping: avg = total / weight-sum
+    assert res.avg_loss == pytest.approx(res.loss / 6513.0, rel=1e-6)
+
+
+def test_auc_near_perfect(agaricus_result):
+    _, res, _ = agaricus_result
+    assert res.train_metrics["auc"] > 0.999
+    assert res.test_metrics["auc"] > 0.999
+    assert res.test_loss < 0.05
+
+
+def test_model_dump_format_and_roundtrip(agaricus_result):
+    p, res, tmp = agaricus_result
+    model_dir = tmp / "lr.model"
+    parts = list(model_dir.iterdir())
+    assert parts and parts[0].name.startswith("model-")
+    lines = parts[0].read_text().strip().split("\n")
+    # bias line has precision "null"
+    bias_lines = [l for l in lines if l.startswith("_bias_")]
+    assert len(bias_lines) == 1 and bias_lines[0].endswith("null")
+    # feature lines: name,weight,precision
+    feat = [l for l in lines if not l.startswith("_bias_")][0]
+    name, w, prec = feat.split(",")
+    float(w), float(prec)
+    # dict sidecar exists
+    dict_dir = tmp / "lr.model_dict"
+    assert dict_dir.exists()
+    dict_names = set((dict_dir / "dict-00000").read_text().split())
+    assert name in dict_names
+
+    # round-trip: load_model reproduces the dumped (nonzero) weights
+    from ytklearn_tpu.io.fs import LocalFileSystem
+    from ytklearn_tpu.models.linear import LinearModel
+
+    ing = DataIngest(p).load()
+    m = LinearModel(p, ing.train.dim)
+    w2 = m.load_model(LocalFileSystem(), ing.feature_map)
+    np.testing.assert_allclose(w2, res.w, atol=1e-6)  # %f dump keeps 6 decimals
+
+
+def test_continue_train_resumes_from_dump(agaricus_result, mesh8):
+    p, res, tmp = agaricus_result
+    cfg = hocon.set_path(dict(p.raw), "model.continue_train", True)
+    cfg = hocon.set_path(cfg, "optimization.line_search.lbfgs.convergence.max_iter", 3)
+    p2 = CommonParams.from_config(cfg)
+    res2 = HoagTrainer(p2, "linear", mesh=mesh8).train()
+    # warm start: first-iteration loss is already near the converged loss
+    assert res2.history[0]["avg_loss"] <= res.avg_loss * 1.5 + 1e-3
+    assert res2.avg_loss <= res.avg_loss * 1.05 + 1e-6
+
+
+def test_l1_owlqn_sparsifies(tmp_path, mesh8):
+    p = _params(
+        tmp_path,
+        **{
+            "loss.regularization.l1": [2.0e-4],
+            "loss.regularization.l2": [0.0],
+            "optimization.line_search.mode": "sufficient_decrease",
+        },
+    )
+    res = HoagTrainer(p, "linear", mesh=mesh8).train()
+    nnz = int(np.sum(np.abs(res.w) > 0))
+    # OWL-QN with L1 must produce exact zeros (orthant projection)
+    assert nnz < res.w.shape[0]
+    assert res.train_metrics["auc"] > 0.99
+
+
+def test_line_search_modes_all_converge(tmp_path, mesh8):
+    for mode in ("sufficient_decrease", "wolfe", "strong_wolfe"):
+        p = _params(
+            tmp_path,
+            **{
+                "optimization.line_search.mode": mode,
+                "optimization.line_search.lbfgs.convergence.max_iter": 15,
+                "model.data_path": str(tmp_path / f"m_{mode}"),
+            },
+        )
+        res = HoagTrainer(p, "linear", mesh=mesh8).train()
+        assert res.avg_loss < 0.15, mode
+
+
+def test_grid_hyper_search_picks_best(tmp_path, mesh8):
+    p = _params(
+        tmp_path,
+        **{
+            "hyper.switch_on": True,
+            "hyper.mode": "grid",
+            "hyper.grid.l1": [0.0],
+            "hyper.grid.l2": [1e-7, 10.0],
+            "optimization.line_search.lbfgs.convergence.max_iter": 10,
+        },
+    )
+    res = HoagTrainer(p, "linear", mesh=mesh8).train()
+    # huge l2 shrinks w to junk; grid must pick the small one by test loss
+    assert res.best_l2 == pytest.approx(1e-7)

@@ -1,0 +1,9 @@
+from .metrics import (
+    EvalSet,
+    auc,
+    auc_from_histogram,
+    auc_histogram,
+    confusion_matrix,
+    create_evaluator_fns,
+    pointwise,
+)

@@ -291,22 +291,26 @@ class DataIngest:
                     pl.labels = self._expand_labels(pl.labels, line)
                     if self.hash is not None:
                         pl.feats = self.hash.hash_features(pl.feats)
+                    if is_train and ys:
+                        # label-dependent subsampling with inverse-probability
+                        # weight correction (reference: CoreData.yExtract) —
+                        # inside the try so a label vector without an exact
+                        # 1.0 counts toward max_error_tol like any bad line
+                        label_idx = (
+                            pl.labels.index(1.0)
+                            if len(pl.labels) > 1
+                            else int(pl.labels[0])
+                        )
+                        rate = ys.get(str(label_idx))
+                        if rate is not None:
+                            pl.weight *= (1.0 / rate) if rate <= 1.0 else rate
+                            if self.rng.random() > rate:
+                                continue
                 except Exception:
                     errors += 1
                     if errors > max_error_tol:
                         raise
                     continue
-                if is_train and ys:
-                    # label-dependent subsampling with inverse-probability
-                    # weight correction (reference: CoreData.yExtract)
-                    label_idx = (
-                        pl.labels.index(1.0) if len(pl.labels) > 1 else int(pl.labels[0])
-                    )
-                    rate = ys.get(str(label_idx))
-                    if rate is not None:
-                        pl.weight *= (1.0 / rate) if rate <= 1.0 else rate
-                        if self.rng.random() > rate:
-                            continue
                 rows.append(pl)
         return rows
 

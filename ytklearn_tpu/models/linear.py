@@ -1,0 +1,182 @@
+"""Linear model family — score kernels, Laplace precision, text model I/O.
+
+Rebuild of reference optimizer/LinearHoagOptimizer.java:76-209 (Xv/XTv loss
+and grad) + dataflow/LinearModelDataFlow.java:68-199 (model text format).
+
+Two data layouts, chosen by density:
+  dense  — X (n, dim) f32: scores = X @ w, an MXU matmul; right for
+           low-dim/dense data (Higgs 28 cols, agaricus one-hot).
+  sparse — padded ELL idx/val (n, width): scores = Σ_j val·w[idx] (gather);
+           right for high-dim CTR-style data where densifying is impossible.
+Rows shard over the mesh data axis in both; w stays replicated. All kernels
+take data as explicit arguments (never closures) so jitted programs stay
+small and cacheable.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config.params import CommonParams
+from ..io.fs import FileSystem
+from ..io.reader import SparseDataset
+from ..losses import LossFunction, create_loss
+
+
+def ell_scores(w, idx, val):
+    """Xv for padded-ELL rows (reference: LinearHoagOptimizer.Xv:76-87).
+    Padding slots (idx=0, val=0) contribute nothing."""
+    return jnp.sum(val * w[idx], axis=-1)
+
+
+class LinearModel:
+    """score = x·w (bias folded in as feature 0)."""
+
+    name = "linear"
+
+    def __init__(
+        self,
+        params: CommonParams,
+        dim: int,
+        loss: Optional[LossFunction] = None,
+        dense: Optional[bool] = None,
+    ):
+        self.params = params
+        self.dim = dim
+        self.loss = loss or create_loss(params.loss.loss_function)
+        # densify when the matrix is small enough to be an MXU win
+        self.dense = dense if dense is not None else dim <= 4096
+
+    # -- batches ---------------------------------------------------------
+
+    def make_batch(self, ds: SparseDataset) -> Tuple[np.ndarray, ...]:
+        """Host arrays for this model's kernels; all shard on rows (dim 0)."""
+        if self.dense:
+            X = np.zeros((ds.n, self.dim), np.float32)
+            rows = np.arange(ds.n)[:, None]
+            # reversed slot order: trailing ELL padding (idx 0, val 0) is
+            # written before the real slot-0 entry, so it can't clobber it
+            X[rows, ds.idx[:, ::-1]] = ds.val[:, ::-1]
+            return (X, ds.y, ds.weight)
+        return (ds.idx, ds.val, ds.y, ds.weight)
+
+    # -- optimization surface -------------------------------------------
+
+    def init_weights(self) -> np.ndarray:
+        return np.zeros((self.dim,), np.float32)
+
+    def regular_range(self) -> Tuple[int, int]:
+        """L1/L2 apply to [start, dim): bias excluded
+        (reference: LinearHoagOptimizer.getRegularStart/End)."""
+        return (1 if self.params.model.need_bias else 0), self.dim
+
+    def reg_vectors(self, l1: float, l2: float) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        start, end = self.regular_range()
+        mask = np.zeros((self.dim,), np.float32)
+        mask[start:end] = 1.0
+        return jnp.asarray(l1 * mask), jnp.asarray(l2 * mask)
+
+    def scores(self, w, *xargs):
+        if self.dense:
+            (X,) = xargs
+            return X @ w
+        idx, val = xargs
+        return ell_scores(w, idx, val)
+
+    def pure_loss(self, w, *batch):
+        """Weighted-sum data loss (reference: calcPureLossAndGrad:127-141).
+
+        Zero-weight rows (mesh padding) are masked with where, not multiply:
+        losses like mape divide by the (padded, zero) label and inf*0 would
+        NaN the whole reduction."""
+        *xargs, y, weight = batch
+        score = self.scores(w, *xargs)
+        per_row = jnp.where(weight > 0, self.loss.loss(score, y), 0.0)
+        return jnp.sum(weight * per_row)
+
+    def predicts(self, w, *batch):
+        *xargs, _y, _weight = batch
+        return self.loss.predict(self.scores(w, *xargs))
+
+    def precision(self, w, *batch, l2_vec, g_weight):
+        """Laplace diagonal precision for Thompson-sampling predictors
+        (reference: LinearHoagOptimizer.calPrecision:179 — bias slot skipped,
+        + total_weight * l2)."""
+        *xargs, y, weight = batch
+        score = self.scores(w, *xargs)
+        D = self.loss.second_derivative(score, y)
+        if self.dense:
+            (X,) = xargs
+            prec = (weight * D) @ (X * X)
+            if self.params.model.need_bias:
+                prec = prec.at[0].set(0.0)
+        else:
+            idx, val = xargs
+            contrib = (weight * D)[:, None] * (val * val)  # (n, width)
+            if self.params.model.need_bias:
+                contrib = jnp.where(idx == 0, 0.0, contrib)
+            prec = jnp.zeros((self.dim,), jnp.float32).at[idx].add(contrib)
+        return prec + g_weight * l2_vec
+
+    # -- model text I/O --------------------------------------------------
+
+    def dump_model(
+        self,
+        fs: FileSystem,
+        w: np.ndarray,
+        precision: Optional[np.ndarray],
+        feature_map: Dict[str, int],
+        rank: int = 0,
+        n_parts: int = 1,
+    ) -> None:
+        """`<model_dir>/model-%05d` + `<model_dir>_dict/dict-%05d`
+        (reference: LinearModelDataFlow.dumpModel:133-199). Nonzero weights
+        only; bias always written with precision "null"."""
+        p = self.params.model
+        w = np.asarray(w)
+        avg = self.dim // n_parts
+        start = rank * avg
+        end = self.dim if rank == n_parts - 1 else (rank + 1) * avg
+        d = p.delim
+        model_path = f"{p.data_path}/model-{rank:05d}"
+        dict_path = f"{p.data_path}_dict/dict-{rank:05d}"
+        with fs.open(model_path, "w") as mf, fs.open(dict_path, "w") as df:
+            for name, i in feature_map.items():
+                if not (start <= i < end):
+                    continue
+                if name.lower() == p.bias_feature_name.lower():
+                    mf.write(f"{name}{d}{w[i]:f}{d}null\n")
+                    continue
+                if abs(w[i]) <= 0.0:
+                    continue
+                prec = precision[i] if precision is not None else 0.0
+                mf.write(f"{name}{d}{w[i]:f}{d}{prec:f}\n")
+                df.write(f"{name}\n")
+
+    def load_model(
+        self, fs: FileSystem, feature_map: Dict[str, int]
+    ) -> Optional[np.ndarray]:
+        """Read `name,weight[,precision]` lines from all model parts
+        (reference: LinearModelDataFlow.loadModel:68-110). Unknown names are
+        skipped; absent file -> None (fresh model)."""
+        p = self.params.model
+        if not fs.exists(p.data_path):
+            return None
+        w = np.zeros((self.dim,), np.float32)
+        for path in sorted(fs.recur_get_paths([p.data_path])):
+            with fs.open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    info = line.split(p.delim)
+                    if len(info) < 2:
+                        continue
+                    gidx = feature_map.get(info[0])
+                    if gidx is not None:
+                        w[gidx] = float(info[1])
+        return w

@@ -1,0 +1,1 @@
+from .lbfgs import LBFGSConfig, LBFGSResult, minimize_lbfgs

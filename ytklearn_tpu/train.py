@@ -1,0 +1,252 @@
+"""Training session driver — the TrainWorker + HoagOperation equivalent.
+
+Rebuild of reference worker/TrainWorker.java:133-236 (session setup) +
+operation/HoagOperation.java:35-40 (convex outer loop) + the grid
+hyper-search rounds of optimizer/HoagOptimizer.java:457-765.
+
+One host process drives the whole mesh: ingest parses text into padded
+arrays, rows are device_put sharded over the mesh data axis, and each L-BFGS
+iteration runs as a single jitted program (collectives inserted by XLA) —
+the reference instead ran slaveNum×threadNum JVM ranks against a CommMaster
+rendezvous.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .config.params import CommonParams
+from .eval import EvalSet
+from .io.fs import FileSystem, LocalFileSystem
+from .io.reader import DataIngest, IngestResult, SparseDataset
+from .models.linear import LinearModel
+from .optimize import LBFGSConfig, minimize_lbfgs
+from .parallel.mesh import row_sharding
+
+log = logging.getLogger("ytklearn_tpu.train")
+
+
+@dataclass
+class TrainResult:
+    w: np.ndarray
+    loss: float  # regularized weighted-sum train loss
+    avg_loss: float
+    pure_loss: float
+    test_loss: Optional[float]
+    n_iter: int
+    status: str
+    train_metrics: Dict[str, float] = field(default_factory=dict)
+    test_metrics: Dict[str, float] = field(default_factory=dict)
+    best_l1: Optional[float] = None
+    best_l2: Optional[float] = None
+    history: List[Dict] = field(default_factory=list)
+
+
+class HoagTrainer:
+    """Convex-family trainer (linear now; multiclass/FM/FFM plug the same
+    surface via their model classes)."""
+
+    def __init__(
+        self,
+        params: CommonParams,
+        model_name: str = "linear",
+        mesh=None,
+        fs: Optional[FileSystem] = None,
+        model_factory: Optional[Callable] = None,
+    ):
+        self.params = params
+        self.model_name = model_name
+        self.mesh = mesh
+        self.fs = fs or LocalFileSystem()
+        self.model_factory = model_factory
+
+    def _make_model(self, dim: int):
+        if self.model_factory is not None:
+            return self.model_factory(self.params, dim)
+        if self.model_name == "linear":
+            return LinearModel(self.params, dim)
+        raise ValueError(f"unknown model {self.model_name!r}")
+
+    def _device_batch(self, model, ds: SparseDataset) -> Tuple:
+        """Build the model's batch and shard rows over the mesh (weights on
+        padding rows are 0 so every weighted reduction ignores them)."""
+        if self.mesh is not None:
+            ds = ds.pad_rows(self.mesh.devices.size)
+        host = model.make_batch(ds)
+        if self.mesh is None:
+            return tuple(jax.device_put(a) for a in host)
+        sh = row_sharding(self.mesh)
+        return tuple(jax.device_put(a, sh) for a in host)
+
+    def train(self, ingest: Optional[IngestResult] = None) -> TrainResult:
+        p = self.params
+        t0 = time.time()
+        if ingest is None:
+            ingest = DataIngest(p, fs=self.fs).load()
+        log.info(
+            "load flow done in %.1fs: %d train rows, dim %d",
+            time.time() - t0,
+            ingest.train.n_real,
+            ingest.train.dim,
+        )
+        model = self._make_model(ingest.train.dim)
+
+        train_b = self._device_batch(model, ingest.train)
+        test_b = self._device_batch(model, ingest.test) if ingest.test else None
+        g_weight = float(np.sum(ingest.train.weight))
+        g_weight_test = float(np.sum(ingest.test.weight)) if ingest.test else 0.0
+
+        # continue_train / just_evaluate warm start (LinearModelDataFlow.loadModel)
+        w0 = None
+        if p.model.continue_train or p.loss.just_evaluate:
+            w0 = model.load_model(self.fs, ingest.feature_map)
+            if w0 is not None:
+                log.info("continue_train: loaded existing model")
+        if w0 is None:
+            w0 = model.init_weights()
+
+        eval_set = EvalSet(p.loss.evaluate_metric) if p.loss.evaluate_metric else None
+        jit_loss = jax.jit(model.pure_loss)
+        jit_predicts = jax.jit(model.predicts)
+        jit_precision = (
+            jax.jit(model.precision) if hasattr(model, "precision") else None
+        )
+
+        def evaluate(w, results_sink: Dict) -> None:
+            if eval_set is not None:
+                results_sink["train_metrics"] = eval_set.evaluate(
+                    jit_predicts(w, *train_b), train_b[-2], train_b[-1]
+                )
+                if test_b is not None:
+                    results_sink["test_metrics"] = eval_set.evaluate(
+                        jit_predicts(w, *test_b), test_b[-2], test_b[-1]
+                    )
+
+        # hyper-search grid (reference grid rounds :457-765) or single run
+        if p.hyper.switch_on and p.hyper.mode == "grid":
+            l1_grid = p.hyper.grid_l1 or [p.loss.l1[0]]
+            l2_grid = p.hyper.grid_l2 or [p.loss.l2[0]]
+            rounds = [(a, b) for a in l1_grid for b in l2_grid]
+        else:
+            if p.hyper.switch_on and p.hyper.mode != "grid":
+                log.warning(
+                    "hyper.mode=%r not implemented yet (grid only); running a "
+                    "single round at l1=%g l2=%g",
+                    p.hyper.mode,
+                    p.loss.l1[0],
+                    p.loss.l2[0],
+                )
+            rounds = [(p.loss.l1[0], p.loss.l2[0])]
+
+        cfg = LBFGSConfig.from_params(p.line_search)
+        best = None  # (test_loss, result, l1, l2)
+        history: List[Dict] = []
+
+        # restart=True: every round restores the *initial* w (incl. any
+        # continue_train warm start); restart=False: rounds carry the
+        # previous round's solution (reference: HoagOptimizer.java:318,469)
+        carry_w = w0
+        for l1, l2 in rounds:
+            l1_vec, l2_vec = model.reg_vectors(l1, l2)
+            start_w = w0 if p.hyper.restart else carry_w
+
+            def callback(it, state, _l1=l1, _l2=l2, _l1v=l1_vec, _l2v=l2_vec):
+                rec = {
+                    "iter": it,
+                    "l1": _l1,
+                    "l2": _l2,
+                    "loss": float(state.loss),
+                    "avg_loss": float(state.loss) / g_weight,
+                    "pure_loss": float(state.pure_loss),
+                }
+                if test_b is not None:
+                    rec["test_loss"] = float(jit_loss(state.w, *test_b)) / max(
+                        g_weight_test, 1e-12
+                    )
+                if it % 5 == 0 or it <= 1:
+                    evaluate(state.w, rec)
+                history.append(rec)
+                log.info(
+                    "[iter=%d] %.1fs train avg loss=%.6f%s",
+                    it,
+                    time.time() - t0,
+                    rec["avg_loss"],
+                    f" test avg loss={rec['test_loss']:.6f}" if "test_loss" in rec else "",
+                )
+                # periodic checkpoint (reference dump_freq block :647-660)
+                if p.model.dump_freq > 0 and it > 0 and it % p.model.dump_freq == 0:
+                    self._dump(
+                        model, state.w, ingest, _l2v, g_weight, train_b, jit_precision
+                    )
+                if p.loss.just_evaluate:
+                    return True
+                return False
+
+            res = minimize_lbfgs(
+                model.pure_loss,
+                jnp.asarray(start_w, jnp.float32),
+                cfg,
+                batch=train_b,
+                l1_vec=l1_vec,
+                l2_vec=l2_vec,
+                g_weight=g_weight,
+                callback=callback,
+            )
+            carry_w = np.asarray(res.w)
+            tl = float(jit_loss(res.w, *test_b)) if test_b is not None else res.loss
+            if best is None or tl < best[0]:
+                best = (tl, res, l1, l2)
+            if len(rounds) > 1:
+                log.info(
+                    "[hyper l1=%g l2=%g] train loss %.6f test loss %s",
+                    l1,
+                    l2,
+                    res.loss / g_weight,
+                    tl / max(g_weight_test, 1e-12) if test_b is not None else "n/a",
+                )
+
+        tl, res, bl1, bl2 = best
+        _, l2_vec = model.reg_vectors(bl1, bl2)
+        self._dump(model, res.w, ingest, l2_vec, g_weight, train_b, jit_precision)
+
+        out = TrainResult(
+            w=np.asarray(res.w),
+            loss=res.loss,
+            avg_loss=res.loss / g_weight,
+            pure_loss=res.pure_loss,
+            test_loss=(tl / max(g_weight_test, 1e-12)) if test_b is not None else None,
+            n_iter=res.n_iter,
+            status=res.status,
+            best_l1=bl1,
+            best_l2=bl2,
+            history=history,
+        )
+        sink: Dict = {}
+        evaluate(res.w, sink)
+        out.train_metrics = sink.get("train_metrics", {})
+        out.test_metrics = sink.get("test_metrics", {})
+        log.info(
+            "training done: %s after %d iters, avg loss %.6f, metrics %s",
+            res.status,
+            res.n_iter,
+            out.avg_loss,
+            out.train_metrics,
+        )
+        return out
+
+    def _dump(
+        self, model, w, ingest, l2_vec, g_weight, train_b, jit_precision=None
+    ) -> None:
+        precision = None
+        if jit_precision is not None:
+            precision = np.asarray(
+                jit_precision(w, *train_b, l2_vec=l2_vec, g_weight=g_weight)
+            )
+        model.dump_model(self.fs, np.asarray(w), precision, ingest.feature_map)
