@@ -1,0 +1,171 @@
+"""Multiclass linear, FM, FFM end-to-end training on reference demo data."""
+
+import numpy as np
+import pytest
+
+from ytklearn_tpu.config import hocon
+from ytklearn_tpu.config.params import CommonParams
+from ytklearn_tpu.io.fs import LocalFileSystem
+from ytklearn_tpu.train import HoagTrainer
+
+REF = "/root/reference"
+
+
+def _params(conf, tmp_path, train, test, **over):
+    cfg = hocon.load(conf)
+    cfg = hocon.set_path(cfg, "data.train.data_path", train)
+    cfg = hocon.set_path(cfg, "data.test.data_path", test)
+    cfg = hocon.set_path(cfg, "model.data_path", str(tmp_path / "m.model"))
+    for k, v in over.items():
+        cfg = hocon.set_path(cfg, k, v)
+    return CommonParams.from_config(cfg)
+
+
+def test_multiclass_linear_dermatology(tmp_path, mesh8):
+    p = _params(
+        f"{REF}/demo/multiclass_linear/multiclass_linear.conf",
+        tmp_path,
+        f"{REF}/demo/data/ytklearn/dermatology.train.ytklearn",
+        f"{REF}/demo/data/ytklearn/dermatology.test.ytklearn",
+        **{"optimization.line_search.lbfgs.convergence.max_iter": 30},
+    )
+    res = HoagTrainer(p, "multiclass_linear", mesh=mesh8).train()
+    losses = [h["avg_loss"] for h in res.history]
+    assert losses[0] == pytest.approx(np.log(6.0), rel=1e-4)  # 6-class chance
+    assert res.avg_loss < 0.15
+    # confusion-matrix accuracy reported
+    assert res.train_metrics["confusion_matrix"] > 0.95
+    assert res.test_metrics["confusion_matrix"] > 0.90
+
+    # model text round-trip: name,w_0..w_4 (K-1 columns)
+    from ytklearn_tpu.io.reader import DataIngest
+    from ytklearn_tpu.models.multiclass import MulticlassLinearModel
+
+    lines = (tmp_path / "m.model" / "model-00000").read_text().strip().split("\n")
+    assert len(lines[0].split(",")) == 1 + 5
+    ing = DataIngest(p, n_labels=6).load()
+    m2 = MulticlassLinearModel(p, ing.train.dim)
+    w2 = m2.load_model(LocalFileSystem(), ing.feature_map)
+    np.testing.assert_allclose(w2, res.w, atol=2e-6)
+
+
+def test_fm_agaricus(tmp_path, mesh8):
+    p = _params(
+        f"{REF}/demo/fm/binary_classification/fm.conf",
+        tmp_path,
+        f"{REF}/demo/data/ytklearn/agaricus.train.ytklearn",
+        f"{REF}/demo/data/ytklearn/agaricus.test.ytklearn",
+        **{"optimization.line_search.lbfgs.convergence.max_iter": 20},
+    )
+    assert p.k == [1, 8] or isinstance(p.k, list)
+    res = HoagTrainer(p, "fm", mesh=mesh8).train()
+    assert res.avg_loss < 0.05
+    assert res.test_metrics["auc"] > 0.999
+
+    # layout: latent factors random-init but bias latent zeroed
+    from ytklearn_tpu.models.fm import FMModel
+
+    m = FMModel(p, 118)
+    w0 = m.init_weights()
+    assert (w0[: m.v_start] == 0).all()
+    assert (w0[m.v_start : m.v_start + m.sok] == 0).all()  # bias latent
+    assert (w0[m.v_start + m.sok :] != 0).any()
+
+    # model line: name,w,v1..vk
+    lines = (tmp_path / "m.model" / "model-00000").read_text().strip().split("\n")
+    feat_line = [l for l in lines if not l.startswith("_bias_")][0]
+    assert len(feat_line.split(",")) == 2 + m.sok
+
+    # round-trip
+    from ytklearn_tpu.io.reader import DataIngest
+
+    ing = DataIngest(p).load()
+    m2 = FMModel(p, ing.train.dim)
+    w2 = m2.load_model(LocalFileSystem(), ing.feature_map)
+    np.testing.assert_allclose(w2, res.w, atol=2e-6)
+
+
+def test_fm_second_order_matters(tmp_path):
+    """FM with XOR-structured data: first-order alone can't fit, latent can."""
+    rng = np.random.RandomState(0)
+    lines = []
+    for _ in range(600):
+        a, b = rng.randint(0, 2), rng.randint(0, 2)
+        y = a ^ b
+        lines.append(f"1###{y}###fa:{2*a-1},fb:{2*b-1}\n")
+    data = tmp_path / "xor.ytk"
+    data.write_text("".join(lines))
+    p = _params(
+        f"{REF}/demo/fm/binary_classification/fm.conf",
+        tmp_path,
+        str(data),
+        "",
+        **{"optimization.line_search.lbfgs.convergence.max_iter": 40,
+           "loss.regularization.l1": [0.0, 0.0],
+           "loss.regularization.l2": [1e-6, 1e-6]},
+    )
+    res = HoagTrainer(p, "fm").train()
+    assert res.train_metrics["auc"] > 0.99  # xor solved via interactions
+
+
+def test_ffm_agaricus(tmp_path, mesh8):
+    p = _params(
+        f"{REF}/demo/ffm/binary_classification/ffm.conf",
+        tmp_path,
+        f"{REF}/demo/data/ytklearn/agaricus.train.ytklearn",
+        f"{REF}/demo/data/ytklearn/agaricus.test.ytklearn",
+        **{
+            "model.field_dict_path": f"{REF}/demo/ffm/binary_classification/field.dict",
+            "optimization.line_search.lbfgs.convergence.max_iter": 15,
+        },
+    )
+    res = HoagTrainer(p, "ffm", mesh=mesh8).train()
+    assert res.avg_loss < 0.1
+    assert res.train_metrics["auc"] > 0.99
+
+    # round-trip: name,w,F*k latent columns
+    from ytklearn_tpu.io.reader import DataIngest
+    from ytklearn_tpu.models.ffm import FFMModel, load_field_dict
+
+    fmap_fields = load_field_dict(LocalFileSystem(), p.model.field_dict_path)
+    F = len(fmap_fields)
+    assert F == 114  # demo field.dict: one field per raw agaricus feature id
+    lines = (tmp_path / "m.model" / "model-00000").read_text().strip().split("\n")
+    feat_line = [l for l in lines if not l.startswith("_bias_")][0]
+    assert len(feat_line.split(",")) == 2 + F * 4  # k=4
+    ing = DataIngest(p, field_map=fmap_fields).load()
+    m2 = FFMModel(p, ing.train.dim, n_fields=F)
+    w2 = m2.load_model(LocalFileSystem(), ing.feature_map)
+    np.testing.assert_allclose(w2, res.w, atol=2e-6)
+
+
+def test_ffm_score_matches_bruteforce():
+    """Field-pair einsum formulation == the reference's O(width^2) loop."""
+    from ytklearn_tpu.models.ffm import FFMModel
+
+    cfg = hocon.load(f"{REF}/demo/ffm/binary_classification/ffm.conf")
+    cfg = hocon.set_path(cfg, "data.train.data_path", "/x")
+    cfg = hocon.set_path(cfg, "model.data_path", "/m")
+    cfg = hocon.set_path(cfg, "bias_need_latent_factor", True)
+    p = CommonParams.from_config(cfg)
+    nf, F, k = 7, 3, 4
+    m = FFMModel(p, nf, n_fields=F)
+    rng = np.random.RandomState(1)
+    w = rng.randn(m.dim).astype(np.float32)
+    n, width = 5, 4
+    idx = rng.randint(0, nf, (n, width)).astype(np.int32)
+    val = rng.randn(n, width).astype(np.float32)
+    field = rng.randint(0, F, (n, width)).astype(np.int32)
+    got = np.asarray(m.scores(w, idx, val, field))
+
+    V = w[nf:].reshape(nf, F, k)
+    want = np.zeros(n)
+    for i in range(n):
+        fx = sum(val[i, j] * w[idx[i, j]] for j in range(width))
+        for a in range(width):
+            for b in range(a + 1, width):
+                vab = V[idx[i, a], field[i, b]]
+                vba = V[idx[i, b], field[i, a]]
+                fx += val[i, a] * val[i, b] * float(vab @ vba)
+        want[i] = fx
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)

@@ -1,0 +1,114 @@
+"""GBST family (gbmlr/gbsdt/gbhmlr/gbhsdt) boosting tests on demo data."""
+
+import numpy as np
+import pytest
+
+from ytklearn_tpu.boost import GBSTTrainer
+from ytklearn_tpu.config import hocon
+from ytklearn_tpu.config.params import CommonParams
+from ytklearn_tpu.io.fs import LocalFileSystem
+from ytklearn_tpu.models.gbst import GBSTModel, heap_leaf_probs
+
+REF = "/root/reference"
+
+
+def _params(variant, tmp_path, **over):
+    cfg = hocon.load(f"{REF}/demo/{variant}/binary_classification/{variant}.conf")
+    cfg = hocon.set_path(
+        cfg, "data.train.data_path", f"{REF}/demo/data/ytklearn/agaricus.train.ytklearn"
+    )
+    cfg = hocon.set_path(
+        cfg, "data.test.data_path", f"{REF}/demo/data/ytklearn/agaricus.test.ytklearn"
+    )
+    cfg = hocon.set_path(cfg, "model.data_path", str(tmp_path / f"{variant}.model"))
+    cfg = hocon.set_path(cfg, "k", 4)
+    cfg = hocon.set_path(cfg, "optimization.line_search.lbfgs.convergence.max_iter", 10)
+    for k, v in over.items():
+        cfg = hocon.set_path(cfg, k, v)
+    return CommonParams.from_config(cfg)
+
+
+def test_heap_leaf_probs_is_distribution():
+    import jax.numpy as jnp
+
+    sig = jnp.asarray(np.random.RandomState(0).rand(7, 3), jnp.float32)
+    p = heap_leaf_probs(sig)
+    assert p.shape == (7, 4)
+    np.testing.assert_allclose(np.asarray(p.sum(axis=-1)), np.ones(7), rtol=1e-6)
+    # leaf 0 = left,left = sig[0]*sig[1]
+    np.testing.assert_allclose(
+        np.asarray(p[:, 0]), np.asarray(sig[:, 0] * sig[:, 1]), rtol=1e-6
+    )
+    # leaf 3 = right,right = (1-sig[0])*(1-sig[2])
+    np.testing.assert_allclose(
+        np.asarray(p[:, 3]), np.asarray((1 - sig[:, 0]) * (1 - sig[:, 2])), rtol=1e-6
+    )
+
+
+@pytest.mark.parametrize("variant", ["gbmlr", "gbsdt", "gbhmlr", "gbhsdt"])
+def test_variant_trains_one_tree(variant, tmp_path, mesh8):
+    p = _params(variant, tmp_path, tree_num=1)
+    res = GBSTTrainer(p, variant, mesh=mesh8).train()
+    assert res.n_trees == 1
+    assert np.isfinite(res.train_loss)
+    assert res.train_loss < np.log(2.0)  # beats chance
+    if variant in ("gbmlr", "gbhmlr"):  # linear experts separate agaricus well
+        assert res.train_metrics["auc"] > 0.99
+
+
+def test_gbmlr_boosting_improves_and_resumes(tmp_path, mesh8):
+    p = _params(
+        "gbmlr", tmp_path, tree_num=3, learning_rate=0.5,
+        instance_sample_rate=0.9, feature_sample_rate=0.8,
+    )
+    res = GBSTTrainer(p, "gbmlr", mesh=mesh8).train()
+    assert res.n_trees == 3
+    assert res.train_loss < 0.1
+    assert res.test_metrics["auc"] > 0.99
+
+    # model dir layout: tree-info + tree-0000N/model-00000
+    mdir = tmp_path / "gbmlr.model"
+    assert (mdir / "tree-info").exists()
+    assert (mdir / "tree-00002" / "model-00000").exists()
+    info = (mdir / "tree-info").read_text()
+    assert "finished_tree_num:3" in info
+    first = (mdir / "tree-00000" / "model-00000").read_text().split("\n")
+    assert first[0] == "k:4"
+    # per-feature line: name + 2K-1=7 values + trailing delim
+    cols = [c for c in first[1].split(",")]
+    assert len(cols) == 1 + 7 + 1 and cols[-1] == ""
+
+    # continue_train: add 2 more trees on top of the 3 dumped ones
+    cfg2 = hocon.set_path(dict(p.raw), "model.continue_train", True)
+    cfg2 = hocon.set_path(cfg2, "tree_num", 5)
+    p2 = CommonParams.from_config(cfg2)
+    res2 = GBSTTrainer(p2, "gbmlr", mesh=mesh8).train()
+    assert res2.n_trees == 5
+    assert res2.train_loss <= res.train_loss * 1.05 + 1e-6
+
+
+def test_gbsdt_tree_roundtrip(tmp_path):
+    p = _params("gbsdt", tmp_path, tree_num=1)
+    res = GBSTTrainer(p, "gbsdt").train()
+    mdir = tmp_path / "gbsdt.model"
+    text = (mdir / "tree-00000" / "model-00000").read_text().split("\n")
+    assert text[0] == "k:4"
+    assert len(text[1].split(",")) == 4  # bare leaf line
+
+    from ytklearn_tpu.io.reader import DataIngest
+
+    ing = DataIngest(p).load()
+    m = GBSTModel(p, ing.train.dim, "gbsdt")
+    w = m.load_tree(LocalFileSystem(), ing.feature_map, 0)
+    assert w is not None
+    assert np.any(w[:4] != 0)  # leaves loaded
+    assert np.any(w[4:] != 0)  # gates loaded
+
+
+def test_random_forest_type(tmp_path):
+    p = _params("gbmlr", tmp_path, tree_num=2, type="random_forest")
+    assert p.gbst_type == "random_forest"
+    res = GBSTTrainer(p, "gbmlr").train()
+    assert res.n_trees == 2
+    assert np.isfinite(res.train_loss)
+    assert res.train_loss < np.log(2.0)

@@ -1,0 +1,219 @@
+"""GBST boosting driver — the GBMLROperation equivalent.
+
+Rebuild of reference operation/GBMLROperation.java:39-124: per tree, run a
+full L-BFGS fit of the soft-tree mixture against the residual objective
+(loss evaluated at z + tree output), then fold the finished tree into z with
+the learning rate (GBMLRDataFlow.accumulate:540), re-randomize the
+instance/feature Bernoulli masks, re-init weights, and continue. Supports
+gradient_boosting and random_forest types, continue_train via the
+tree-info + tree-%05d model files.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .config.params import CommonParams
+from .eval import EvalSet
+from .io.fs import FileSystem, LocalFileSystem
+from .io.reader import DataIngest, IngestResult
+from .losses import create_loss
+from .models.gbst import GBSTModel
+from .optimize import LBFGSConfig, minimize_lbfgs
+from .parallel.mesh import row_sharding
+
+log = logging.getLogger("ytklearn_tpu.boost")
+
+
+@dataclass
+class BoostResult:
+    n_trees: int
+    train_loss: float  # avg loss of the accumulated ensemble
+    test_loss: Optional[float]
+    train_metrics: Dict[str, float] = field(default_factory=dict)
+    test_metrics: Dict[str, float] = field(default_factory=dict)
+    per_tree_loss: List[float] = field(default_factory=list)
+
+
+class GBSTTrainer:
+    """Boosted soft-tree trainer for gbmlr/gbsdt/gbhmlr/gbhsdt."""
+
+    def __init__(
+        self,
+        params: CommonParams,
+        variant: str,
+        mesh=None,
+        fs: Optional[FileSystem] = None,
+    ):
+        self.params = params
+        self.variant = variant
+        self.mesh = mesh
+        self.fs = fs or LocalFileSystem()
+
+    def _put(self, arr):
+        if self.mesh is None:
+            return jax.device_put(arr)
+        return jax.device_put(arr, row_sharding(self.mesh))
+
+    def _put_rep(self, arr):
+        return jax.device_put(arr)
+
+    def train(self, ingest: Optional[IngestResult] = None) -> BoostResult:
+        p = self.params
+        t0 = time.time()
+        if ingest is None:
+            ingest = DataIngest(p, fs=self.fs).load()
+        ds_train = ingest.train
+        ds_test = ingest.test
+        if self.mesh is not None:
+            ds_train = ds_train.pad_rows(self.mesh.devices.size)
+            ds_test = ds_test.pad_rows(self.mesh.devices.size) if ds_test else None
+
+        model = GBSTModel(p, ingest.train.dim, self.variant)
+        loss_fn = model.loss
+        base_score = float(loss_fn.pred2score(p.uniform_base_prediction))
+        lr = p.learning_rate
+        tree_num = p.tree_num
+        g_weight = float(np.sum(ds_train.weight))
+        g_weight_test = float(np.sum(ds_test.weight)) if ds_test else 0.0
+
+        idx = self._put(ds_train.idx)
+        val = self._put(ds_train.val)
+        y = self._put(ds_train.y)
+        weight = self._put(ds_train.weight)
+        # padding rows keep weight 0; z starts at the base score
+        z = self._put(np.full((ds_train.n,), base_score, np.float32))
+        if ds_test is not None:
+            idx_t = self._put(ds_test.idx)
+            val_t = self._put(ds_test.val)
+            y_t = self._put(ds_test.y)
+            weight_t = self._put(ds_test.weight)
+            z_t = self._put(np.full((ds_test.n,), base_score, np.float32))
+
+        eval_set = EvalSet(p.loss.evaluate_metric) if p.loss.evaluate_metric else None
+        cfg = LBFGSConfig.from_params(p.line_search)
+
+        jit_tree_out = jax.jit(model.tree_output)
+        jit_ens_loss = jax.jit(lambda s, yy, ww: _ensemble_loss(loss_fn, s, yy, ww))
+        l1_vec, l2_vec = model.reg_vectors(p.loss.l1[0], p.loss.l2[0])
+
+        # continue_train: replay finished trees into z
+        # (reference: GBMLRDataFlow.loadModel + per-tree accumulate)
+        finished = 0
+        info = model.load_tree_info(self.fs)
+        if (p.model.continue_train or p.loss.just_evaluate) and info is not None:
+            finished = int(info["finished_tree_num"])
+            full_mask = self._put_rep(np.ones((model.n_features,), np.float32))
+            for t in range(finished):
+                wt = model.load_tree(self.fs, ingest.feature_map, t)
+                if wt is None:
+                    raise FileNotFoundError(f"tree-{t:05d} missing for continue_train")
+                wt = self._put_rep(wt)
+                z = z + lr * jit_tree_out(wt, idx, val, full_mask)
+                if ds_test is not None:
+                    z_t = z_t + lr * jit_tree_out(wt, idx_t, val_t, full_mask)
+            log.info("continue_train: replayed %d finished trees", finished)
+
+        rng = np.random.RandomState(p.random.seed)
+        per_tree_loss: List[float] = []
+        compensate = 1.0 / p.instance_sample_rate
+
+        for tree in range(finished, tree_num):
+            # per-tree Bernoulli masks (reference: randomNextSample)
+            inst = (rng.rand(ds_train.n) <= p.instance_sample_rate).astype(np.float32)
+            inst[ds_train.n_real :] = 0.0
+            gmask_np = (rng.rand(model.n_features) <= p.feature_sample_rate).astype(
+                np.float32
+            )
+            if p.model.need_bias:
+                gmask_np[0] = 1.0
+            gmask = self._put_rep(gmask_np)
+            w_eff = self._put(np.asarray(ds_train.weight) * inst * compensate)
+
+            w0 = model.init_weights(tree_seed=tree)
+            batch = (idx, val, z, gmask, y, w_eff)
+            res = minimize_lbfgs(
+                model.pure_loss,
+                self._put_rep(w0),
+                cfg,
+                batch=batch,
+                l1_vec=l1_vec,
+                l2_vec=l2_vec,
+                g_weight=g_weight,
+                callback=(lambda it, st: True) if p.loss.just_evaluate else None,
+            )
+            per_tree_loss.append(res.loss / g_weight)
+            if p.loss.just_evaluate:
+                break
+
+            # accumulate (reference: GBMLRDataFlow.accumulate — lr-shrunk)
+            w_tree = res.w
+            z = z + lr * jit_tree_out(w_tree, idx, val, gmask)
+            if ds_test is not None:
+                z_t = z_t + lr * jit_tree_out(w_tree, idx_t, val_t, gmask)
+
+            # dump tree + info (reference: dumpModel + dumpModelInfo)
+            model.dump_tree(
+                self.fs, np.asarray(w_tree), gmask_np, ingest.feature_map, tree
+            )
+            model.dump_tree_info(self.fs, tree + 1, base_score)
+
+            ens = self._ensemble_scores(z, tree + 1)
+            tl = float(jit_ens_loss(ens, y, weight)) / g_weight
+            msg = f"[tree={tree}] {time.time()-t0:.1f}s fit avg loss={per_tree_loss[-1]:.6f} ensemble avg loss={tl:.6f}"
+            if ds_test is not None:
+                ens_t = self._ensemble_scores(z_t, tree + 1)
+                ttl = float(jit_ens_loss(ens_t, y_t, weight_t)) / max(
+                    g_weight_test, 1e-12
+                )
+                msg += f" test={ttl:.6f}"
+            log.info(msg)
+
+        n_built = max(tree_num - finished, 0) + finished
+        ens = self._ensemble_scores(z, max(n_built, 1))
+        train_loss = float(jit_ens_loss(ens, y, weight)) / g_weight
+        out = BoostResult(
+            n_trees=n_built,
+            train_loss=train_loss,
+            test_loss=None,
+            per_tree_loss=per_tree_loss,
+        )
+        if eval_set is not None:
+            out.train_metrics = eval_set.evaluate(
+                loss_fn.predict(ens), y, weight
+            )
+        if ds_test is not None:
+            ens_t = self._ensemble_scores(z_t, max(n_built, 1))
+            out.test_loss = float(jit_ens_loss(ens_t, y_t, weight_t)) / max(
+                g_weight_test, 1e-12
+            )
+            if eval_set is not None:
+                out.test_metrics = eval_set.evaluate(
+                    loss_fn.predict(ens_t), y_t, weight_t
+                )
+        log.info(
+            "boosting done: %d trees, train loss %.6f, metrics %s",
+            out.n_trees,
+            out.train_loss,
+            out.train_metrics,
+        )
+        return out
+
+    def _ensemble_scores(self, z, n_trees: int):
+        """GB: z is the ensemble score; RF: averaged (reference (z)/treeNum
+        at predict time)."""
+        if self.params.gbst_type == "random_forest":
+            return z / n_trees
+        return z
+
+
+def _ensemble_loss(loss_fn, scores, y, weight):
+    per_row = jnp.where(weight > 0, loss_fn.loss(scores, y), 0.0)
+    return jnp.sum(weight * per_row)

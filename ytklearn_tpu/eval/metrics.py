@@ -54,7 +54,10 @@ def auc_from_histogram(hist) -> jnp.ndarray:
     total_pos = jnp.sum(pos)
     pos_above = total_pos - jnp.cumsum(pos)
     pair_sum = jnp.sum(neg * (pos_above + 0.5 * pos))
-    return pair_sum / (total_pos * jnp.sum(neg))
+    denom = total_pos * jnp.sum(neg)
+    # single-class data has no pairs; report 0.5 instead of NaN (the
+    # reference divides by zero here — we prefer a defined value)
+    return jnp.where(denom > 0, pair_sum / jnp.where(denom > 0, denom, 1.0), 0.5)
 
 
 def auc(pred, y, weight=None, slots: int = DEFAULT_AUC_SLOTS):

@@ -186,6 +186,7 @@ class SparseDataset:
     weight: np.ndarray  # (n,) float32
     n_real: int  # rows before padding
     dim: int  # feature dimension (dict size)
+    field: Optional[np.ndarray] = None  # (n, width) int32, FFM only
 
     @property
     def n(self) -> int:
@@ -206,6 +207,7 @@ class SparseDataset:
             val=np.pad(self.val, ((0, pad), (0, 0))),
             y=np.pad(self.y, ((0, pad),) + ((0, 0),) * (self.y.ndim - 1)),
             weight=np.pad(self.weight, (0, pad)),
+            field=None if self.field is None else np.pad(self.field, ((0, pad), (0, 0))),
         )
 
 
@@ -238,12 +240,17 @@ class DataIngest:
         n_labels: int = 1,
         label_as_class_index: bool = False,
         transform_hook: Optional[Callable[[bytes], List[str]]] = None,
+        field_map: Optional[Dict[str, int]] = None,
     ):
         self.params = params
         self.fs = fs or LocalFileSystem()
         self.n_labels = n_labels  # K for multiclass losses, else 1
         self.label_as_class_index = label_as_class_index
         self.transform_hook = transform_hook
+        # FFM: field = feature-name prefix before field_delim, mapped through
+        # the field dict; features with unknown fields are dropped
+        # (reference: FFMModelDataFlow.updateX)
+        self.field_map = field_map
         p = params
         self.hash = (
             FeatureHash(
@@ -449,32 +456,42 @@ class DataIngest:
         need_bias = p.model.need_bias
         n = len(rows)
         K = self.n_labels
-        mapped: List[List[Tuple[int, float]]] = []
+        fm = self.field_map
+        fdelim = p.data.delim.field_delim
+        mapped: List[List[Tuple[int, float, int]]] = []
         width = 1 if need_bias else 0
         for r in rows:
-            entries: List[Tuple[int, float]] = []
+            entries: List[Tuple[int, float, int]] = []
             if need_bias:
-                entries.append((0, 1.0))
+                entries.append((0, 1.0, 0))  # bias field 0 (FFMModelDataFlow)
             for name, v in r.feats:
                 gi = fmap.get(name)
                 if gi is None:
                     continue  # filtered feature — dropped like handleLocalIdx
+                fi = 0
+                if fm is not None:
+                    fi = fm.get(name.split(fdelim)[0], -1)
+                    if fi < 0:
+                        continue  # unknown field — dropped
                 node = nodes.get(gi)
-                entries.append((gi, node.transform(v) if node else v))
+                entries.append((gi, node.transform(v) if node else v, fi))
             mapped.append(entries)
             width = max(width, len(entries))
         width = max(width, 1)
         idx = np.zeros((n, width), np.int32)
         val = np.zeros((n, width), np.float32)
+        field = np.zeros((n, width), np.int32) if fm is not None else None
         for i, entries in enumerate(mapped):
-            for j, (gi, v) in enumerate(entries):
+            for j, (gi, v, fi) in enumerate(entries):
                 idx[i, j] = gi
                 val[i, j] = v
+                if field is not None:
+                    field[i, j] = fi
         y = np.asarray(
             [r.labels for r in rows], np.float32
         ).reshape((n, K)) if K > 1 else np.asarray([r.labels[0] for r in rows], np.float32)
         weight = np.asarray([r.weight for r in rows], np.float32)
-        return SparseDataset(idx, val, y, weight, n_real=n, dim=len(fmap))
+        return SparseDataset(idx, val, y, weight, n_real=n, dim=len(fmap), field=field)
 
     # -- the whole flow ---------------------------------------------------
 

@@ -1,1 +1,5 @@
+from .base import ConvexModel, random_init
 from .linear import LinearModel
+from .multiclass import MulticlassLinearModel
+from .fm import FMModel
+from .ffm import FFMModel, load_field_dict

@@ -1,0 +1,159 @@
+"""Shared scaffolding for the convex model family.
+
+Each model supplies: flat-weight layout (+ init / grad masks), a pure-jnp
+weighted-sum loss over its batch arrays, predictions, reg-range vectors, and
+reference-compatible text model I/O. The optimizer (optimize/lbfgs.py) and
+trainer (train.py) are model-agnostic.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..config.params import CommonParams
+from ..io.fs import FileSystem
+from ..io.reader import SparseDataset
+from ..losses import create_loss
+
+
+def random_init(params: CommonParams, size: int) -> np.ndarray:
+    """Latent-factor init (reference: utils/RandomParamsUtils.java:37,
+    param/RandomParams.java — normal(mean, std) or uniform[a, b))."""
+    r = params.random
+    rng = np.random.RandomState(r.seed)
+    if r.mode == "uniform":
+        return rng.uniform(
+            r.uniform_range_start, r.uniform_range_end, size
+        ).astype(np.float32)
+    return (rng.randn(size) * r.normal_std + r.normal_mean).astype(np.float32)
+
+
+class ConvexModel:
+    """Base for L-BFGS-trained models."""
+
+    name = "base"
+    n_labels = 1  # K for multiclass families
+
+    def __init__(self, params: CommonParams, n_features: int):
+        self.params = params
+        self.n_features = n_features
+        self.loss = create_loss(params.loss.loss_function)
+
+    # layout ------------------------------------------------------------
+    @property
+    def dim(self) -> int:
+        raise NotImplementedError
+
+    def init_weights(self) -> np.ndarray:
+        return np.zeros((self.dim,), np.float32)
+
+    def regular_blocks(self) -> List[Tuple[int, int]]:
+        """[(start, end)] ranges regularized by l1[r]/l2[r]
+        (reference: HoagOptimizer.getRegularStart/End overrides)."""
+        raise NotImplementedError
+
+    def reg_vectors(self, l1, l2) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """Per-index reg coefficient vectors from the per-block l1/l2 lists
+        (scalars broadcast to every block)."""
+        blocks = self.regular_blocks()
+        l1s = list(np.broadcast_to(np.atleast_1d(l1), (len(blocks),)))
+        l2s = list(np.broadcast_to(np.atleast_1d(l2), (len(blocks),)))
+        l1v = np.zeros((self.dim,), np.float32)
+        l2v = np.zeros((self.dim,), np.float32)
+        for (s, e), a, b in zip(blocks, l1s, l2s):
+            l1v[s:e] = a
+            l2v[s:e] = b
+        return jnp.asarray(l1v), jnp.asarray(l2v)
+
+    # batches ------------------------------------------------------------
+    def make_batch(self, ds: SparseDataset) -> Tuple[np.ndarray, ...]:
+        """(idx, val, y, weight) padded-ELL by default; all arrays row-shard."""
+        return (ds.idx, ds.val, ds.y, ds.weight)
+
+    # kernels ------------------------------------------------------------
+    def pure_loss(self, w, *batch):
+        """Weighted-sum data loss; zero-weight padding rows masked via where
+        (inf*0 from e.g. mape on padded labels must not NaN the sum)."""
+        *xargs, y, weight = batch
+        scores = self.scores(w, *xargs)
+        # loss() reduces multiclass trailing axes, so per_row is always (n,)
+        per_row = jnp.where(weight > 0, self.loss.loss(scores, y), 0.0)
+        return jnp.sum(weight * per_row)
+
+    def scores(self, w, *xargs):
+        raise NotImplementedError
+
+    def predicts(self, w, *batch):
+        *xargs, _y, _w = batch
+        return self.loss.predict(self.scores(w, *xargs))
+
+    # model I/O ----------------------------------------------------------
+    def _part_paths(self, rank: int) -> Tuple[str, str]:
+        p = self.params.model
+        return (
+            f"{p.data_path}/model-{rank:05d}",
+            f"{p.data_path}_dict/dict-{rank:05d}",
+        )
+
+    def _feature_slice(self, rank: int, n_parts: int) -> Tuple[int, int]:
+        avg = self.n_features // n_parts
+        start = rank * avg
+        end = self.n_features if rank == n_parts - 1 else (rank + 1) * avg
+        return start, end
+
+    def dump_model(
+        self,
+        fs: FileSystem,
+        w: np.ndarray,
+        precision: Optional[np.ndarray],
+        feature_map: Dict[str, int],
+        rank: int = 0,
+        n_parts: int = 1,
+    ) -> None:
+        """Per-feature text lines; subclasses supply model_line()."""
+        p = self.params.model
+        start, end = self._feature_slice(rank, n_parts)
+        model_path, dict_path = self._part_paths(rank)
+        with fs.open(model_path, "w") as mf, fs.open(dict_path, "w") as df:
+            for name, i in feature_map.items():
+                if not (start <= i < end):
+                    continue
+                is_bias = name.lower() == p.bias_feature_name.lower()
+                line = self.model_line(name, i, w, precision, is_bias)
+                if line is None:
+                    continue
+                mf.write(line + "\n")
+                if not is_bias:
+                    df.write(name + "\n")
+
+    def model_line(
+        self, name: str, i: int, w: np.ndarray, precision, is_bias: bool
+    ) -> Optional[str]:
+        raise NotImplementedError
+
+    def load_model(
+        self, fs: FileSystem, feature_map: Dict[str, int]
+    ) -> Optional[np.ndarray]:
+        p = self.params.model
+        if not fs.exists(p.data_path):
+            return None
+        w = self.init_weights()
+        for path in sorted(fs.recur_get_paths([p.data_path])):
+            with fs.open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    info = line.split(p.delim)
+                    if len(info) < 2:
+                        continue
+                    gidx = feature_map.get(info[0])
+                    if gidx is not None:
+                        self.apply_model_line(w, gidx, info)
+        return w
+
+    def apply_model_line(self, w: np.ndarray, gidx: int, info: Sequence[str]):
+        raise NotImplementedError

@@ -1,0 +1,95 @@
+"""Factorization machine.
+
+Rebuild of reference optimizer/FMHoagOptimizer.java:88 (the O(nk)
+sum/sum-of-squares trick) + dataflow/FMModelDataFlow.java (layout
+[w1 (n_features)] ++ [V (n_features*k)], V random-init, bias latent zeroed;
+model text `name,w,v1,...,vk`).
+
+fx = x·w1 + 0.5 Σ_f [(Σ_j v_jf x_j)^2 - Σ_j (v_jf x_j)^2]; the gradient
+falls out of autodiff identically to the reference's closed form. Gradient
+masks (first/second order switches, bias latent) are applied by masking the
+*weights inside the score*: masked slots start at 0 and their chain-rule
+gradient is 0, which reproduces the reference's g[i]=0 zeroing exactly.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..config.params import CommonParams
+from ..io.reader import SparseDataset
+from .base import ConvexModel, random_init
+
+
+class FMModel(ConvexModel):
+    name = "fm"
+
+    def __init__(self, params: CommonParams, n_features: int):
+        super().__init__(params, n_features)
+        k = params.k
+        if not (isinstance(k, (list, tuple)) and len(k) == 2):
+            raise ValueError(f"fm config k must be [first_order(0/1), latent_dim]: {k!r}")
+        self.need_first_order = int(k[0]) >= 1
+        self.sok = int(k[1])
+        self.need_second_order = self.sok > 0
+        self.v_start = n_features  # secondOrderIndexStart
+
+    @property
+    def dim(self) -> int:
+        return self.n_features * (1 + self.sok)
+
+    def regular_blocks(self):
+        """Two blocks: first-order (bias excluded) and latent
+        (reference: FMHoagOptimizer.getRegularStart/End)."""
+        fo_start = 1 if self.params.model.need_bias else 0
+        return [(fo_start, self.v_start), (self.v_start, self.dim)]
+
+    def init_weights(self) -> np.ndarray:
+        w = np.zeros((self.dim,), np.float32)
+        w[self.v_start:] = random_init(self.params, self.dim - self.v_start)
+        if self.params.model.need_bias:
+            w[self.v_start : self.v_start + self.sok] = 0.0  # bias latent
+        return w
+
+    def _apply_mask(self, w):
+        """Zero masked weight slices in-graph (static slice bounds, no big
+        captured constants); masked slots init at 0 and get 0 gradient via
+        the chain rule — reproducing the reference's g[i]=0 zeroing."""
+        if not self.need_first_order:
+            fo_start = 1 if self.params.model.need_bias else 0
+            w = w.at[fo_start : self.v_start].set(0.0)
+        if not self.need_second_order:
+            w = w.at[self.v_start :].set(0.0)
+        elif self.params.model.need_bias and not self.params.bias_need_latent_factor:
+            w = w.at[self.v_start : self.v_start + self.sok].set(0.0)
+        return w
+
+    def scores(self, w, *xargs):
+        idx, val = xargs
+        w = self._apply_mask(w)
+        wx = jnp.sum(val * w[: self.v_start][idx], axis=-1)
+        if not self.need_second_order:
+            return wx
+        V = w[self.v_start :].reshape(self.n_features, self.sok)
+        vx = V[idx] * val[..., None]  # (n, width, k)
+        S = jnp.sum(vx, axis=1)  # Σ v x
+        S2 = jnp.sum(vx * vx, axis=1)  # Σ (v x)^2
+        return wx + 0.5 * jnp.sum(S * S - S2, axis=-1)
+
+    # -- model text I/O: name,w,v1,...,vk --------------------------------
+
+    def model_line(self, name, i, w, precision, is_bias):
+        w = np.asarray(w)
+        d = self.params.model.delim
+        V = w[self.v_start :].reshape(self.n_features, self.sok)
+        lat = d.join(repr(float(v)) for v in V[i])
+        return f"{name}{d}{w[i]:f}{d}{lat}"
+
+    def apply_model_line(self, w, gidx, info: Sequence[str]):
+        w[gidx] = float(info[1])
+        start = self.v_start + gidx * self.sok
+        for f in range(self.sok):
+            w[start + f] = float(info[2 + f])

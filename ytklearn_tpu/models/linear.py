@@ -25,6 +25,7 @@ from ..config.params import CommonParams
 from ..io.fs import FileSystem
 from ..io.reader import SparseDataset
 from ..losses import LossFunction, create_loss
+from .base import ConvexModel
 
 
 def ell_scores(w, idx, val):
@@ -33,7 +34,7 @@ def ell_scores(w, idx, val):
     return jnp.sum(val * w[idx], axis=-1)
 
 
-class LinearModel:
+class LinearModel(ConvexModel):
     """score = x·w (bias folded in as feature 0)."""
 
     name = "linear"
@@ -45,11 +46,19 @@ class LinearModel:
         loss: Optional[LossFunction] = None,
         dense: Optional[bool] = None,
     ):
-        self.params = params
-        self.dim = dim
-        self.loss = loss or create_loss(params.loss.loss_function)
+        super().__init__(params, dim)
+        if loss is not None:
+            self.loss = loss
         # densify when the matrix is small enough to be an MXU win
         self.dense = dense if dense is not None else dim <= 4096
+
+    @property
+    def dim(self) -> int:
+        return self.n_features
+
+    def regular_blocks(self):
+        start, end = self.regular_range()
+        return [(start, end)]
 
     # -- batches ---------------------------------------------------------
 
@@ -66,19 +75,10 @@ class LinearModel:
 
     # -- optimization surface -------------------------------------------
 
-    def init_weights(self) -> np.ndarray:
-        return np.zeros((self.dim,), np.float32)
-
     def regular_range(self) -> Tuple[int, int]:
         """L1/L2 apply to [start, dim): bias excluded
         (reference: LinearHoagOptimizer.getRegularStart/End)."""
         return (1 if self.params.model.need_bias else 0), self.dim
-
-    def reg_vectors(self, l1: float, l2: float) -> Tuple[jnp.ndarray, jnp.ndarray]:
-        start, end = self.regular_range()
-        mask = np.zeros((self.dim,), np.float32)
-        mask[start:end] = 1.0
-        return jnp.asarray(l1 * mask), jnp.asarray(l2 * mask)
 
     def scores(self, w, *xargs):
         if self.dense:
@@ -86,21 +86,6 @@ class LinearModel:
             return X @ w
         idx, val = xargs
         return ell_scores(w, idx, val)
-
-    def pure_loss(self, w, *batch):
-        """Weighted-sum data loss (reference: calcPureLossAndGrad:127-141).
-
-        Zero-weight rows (mesh padding) are masked with where, not multiply:
-        losses like mape divide by the (padded, zero) label and inf*0 would
-        NaN the whole reduction."""
-        *xargs, y, weight = batch
-        score = self.scores(w, *xargs)
-        per_row = jnp.where(weight > 0, self.loss.loss(score, y), 0.0)
-        return jnp.sum(weight * per_row)
-
-    def predicts(self, w, *batch):
-        *xargs, _y, _weight = batch
-        return self.loss.predict(self.scores(w, *xargs))
 
     def precision(self, w, *batch, l2_vec, g_weight):
         """Laplace diagonal precision for Thompson-sampling predictors

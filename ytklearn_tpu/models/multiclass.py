@@ -1,0 +1,75 @@
+"""Multiclass linear model (softmax / multiclass hinge family).
+
+Rebuild of reference optimizer/MulticlassLinearHoagOptimizer.java:82 +
+dataflow/MulticlassLinearModelDataFlow.java (dim = n_features*(K-1), w laid
+out feature-major with stride K-1; the K-th class score is implicitly 0).
+
+TPU shape: W viewed as (n_features, K-1); sparse scores are one gather +
+einsum over the ELL width, dense scores a single (n, F) @ (F, K-1) MXU
+matmul.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..config.params import CommonParams
+from ..io.reader import SparseDataset
+from .base import ConvexModel
+
+
+class MulticlassLinearModel(ConvexModel):
+    name = "multiclass_linear"
+
+    def __init__(self, params: CommonParams, n_features: int, dense: Optional[bool] = None):
+        super().__init__(params, n_features)
+        self.K = int(params.k)
+        if not self.loss.is_multiclass:
+            raise ValueError(
+                f"multiclass_linear needs a multiclass loss, got {self.loss.name!r}"
+            )
+        self.n_labels = self.K
+        self.dense = dense if dense is not None else n_features <= 4096
+
+    @property
+    def dim(self) -> int:
+        return self.n_features * (self.K - 1)
+
+    def regular_blocks(self):
+        """Bias block (feature 0's K-1 weights) excluded when need_bias."""
+        start = (self.K - 1) if self.params.model.need_bias else 0
+        return [(start, self.dim)]
+
+    def make_batch(self, ds: SparseDataset) -> Tuple[np.ndarray, ...]:
+        if self.dense:
+            X = np.zeros((ds.n, self.n_features), np.float32)
+            rows = np.arange(ds.n)[:, None]
+            X[rows, ds.idx[:, ::-1]] = ds.val[:, ::-1]
+            return (X, ds.y, ds.weight)
+        return (ds.idx, ds.val, ds.y, ds.weight)
+
+    def scores(self, w, *xargs):
+        """(n, K) scores, last class fixed at 0 (reference keeps wx[K-1]=0)."""
+        W = w.reshape(self.n_features, self.K - 1)
+        if self.dense:
+            (X,) = xargs
+            s = X @ W  # (n, K-1)
+        else:
+            idx, val = xargs
+            s = jnp.einsum("nw,nwk->nk", val, W[idx])
+        return jnp.concatenate([s, jnp.zeros_like(s[:, :1])], axis=1)
+
+    # -- model text I/O: name,w_0,...,w_{K-2} ----------------------------
+
+    def model_line(self, name, i, w, precision, is_bias):
+        W = np.asarray(w).reshape(self.n_features, self.K - 1)
+        d = self.params.model.delim
+        return name + d + d.join(repr(float(v)) for v in W[i])
+
+    def apply_model_line(self, w, gidx, info: Sequence[str]):
+        W = w.reshape(self.n_features, self.K - 1)
+        for j in range(self.K - 1):
+            W[gidx, j] = float(info[1 + j])

@@ -126,8 +126,12 @@ def _loss_grad(pure_loss_fn, has_l1: bool, w, reg: Reg, batch):
 
 # program cache: (pure_loss_fn, trace-relevant config fields, has_l1) ->
 # (first_eval, iteration). max_iter/eps only drive the host loop and must
-# not key the cache (they'd force pointless recompiles).
-_PROGRAMS: dict = {}
+# not key the cache (they'd force pointless recompiles). Bounded LRU so a
+# long-lived process sweeping many models doesn't pin executables forever.
+from collections import OrderedDict
+
+_PROGRAMS: "OrderedDict" = OrderedDict()
+_PROGRAMS_MAX = 16
 
 
 def _trace_key(config: LBFGSConfig):
@@ -148,6 +152,7 @@ def _build_programs(pure_loss_fn, config: LBFGSConfig, has_l1: bool):
     key = (pure_loss_fn, _trace_key(config), has_l1)
     hit = _PROGRAMS.get(key)
     if hit is not None:
+        _PROGRAMS.move_to_end(key)
         return hit
 
     m = config.m
@@ -314,6 +319,8 @@ def _build_programs(pure_loss_fn, config: LBFGSConfig, has_l1: bool):
         return new_state, jnp.linalg.norm(w), jnp.linalg.norm(g)
 
     _PROGRAMS[key] = (first_eval, iteration)
+    while len(_PROGRAMS) > _PROGRAMS_MAX:
+        _PROGRAMS.popitem(last=False)
     return first_eval, iteration
 
 

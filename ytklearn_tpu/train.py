@@ -67,11 +67,47 @@ class HoagTrainer:
         self.fs = fs or LocalFileSystem()
         self.model_factory = model_factory
 
-    def _make_model(self, dim: int):
+    def _ingest(self) -> IngestResult:
+        """Model-aware ingest (reference: DataFlowFactory.createDataFlow:37-72
+        — each family has its own dataflow; here only label width and the
+        FFM field map differ)."""
+        p = self.params
+        kwargs = {}
+        if self.model_name == "multiclass_linear":
+            kwargs["n_labels"] = int(p.k)
+        elif self.model_name == "ffm":
+            from .models.ffm import load_field_dict
+
+            if not p.model.field_dict_path:
+                raise ValueError("ffm requires model.field_dict_path")
+            self._field_map = load_field_dict(self.fs, p.model.field_dict_path)
+            kwargs["field_map"] = self._field_map
+        return DataIngest(p, fs=self.fs, **kwargs).load()
+
+    def _make_model(self, ingest: IngestResult):
+        dim = ingest.train.dim
         if self.model_factory is not None:
             return self.model_factory(self.params, dim)
         if self.model_name == "linear":
             return LinearModel(self.params, dim)
+        if self.model_name == "multiclass_linear":
+            from .models.multiclass import MulticlassLinearModel
+
+            return MulticlassLinearModel(self.params, dim)
+        if self.model_name == "fm":
+            from .models.fm import FMModel
+
+            return FMModel(self.params, dim)
+        if self.model_name == "ffm":
+            from .models.ffm import FFMModel, load_field_dict
+
+            # reuse the dict _ingest loaded so n_fields always matches the
+            # field indices baked into ds.field (a caller-supplied ingest
+            # must carry the same dict)
+            field_map = getattr(self, "_field_map", None) or load_field_dict(
+                self.fs, self.params.model.field_dict_path
+            )
+            return FFMModel(self.params, dim, n_fields=len(field_map))
         raise ValueError(f"unknown model {self.model_name!r}")
 
     def _device_batch(self, model, ds: SparseDataset) -> Tuple:
@@ -89,14 +125,14 @@ class HoagTrainer:
         p = self.params
         t0 = time.time()
         if ingest is None:
-            ingest = DataIngest(p, fs=self.fs).load()
+            ingest = self._ingest()
         log.info(
             "load flow done in %.1fs: %d train rows, dim %d",
             time.time() - t0,
             ingest.train.n_real,
             ingest.train.dim,
         )
-        model = self._make_model(ingest.train.dim)
+        model = self._make_model(ingest)
 
         train_b = self._device_batch(model, ingest.train)
         test_b = self._device_batch(model, ingest.test) if ingest.test else None
@@ -112,7 +148,12 @@ class HoagTrainer:
         if w0 is None:
             w0 = model.init_weights()
 
-        eval_set = EvalSet(p.loss.evaluate_metric) if p.loss.evaluate_metric else None
+        eval_k = max(getattr(model, "n_labels", 1), 2)
+        eval_set = (
+            EvalSet(p.loss.evaluate_metric, K=eval_k)
+            if p.loss.evaluate_metric
+            else None
+        )
         jit_loss = jax.jit(model.pure_loss)
         jit_predicts = jax.jit(model.predicts)
         jit_precision = (
@@ -200,7 +241,12 @@ class HoagTrainer:
                 callback=callback,
             )
             carry_w = np.asarray(res.w)
-            tl = float(jit_loss(res.w, *test_b)) if test_b is not None else res.loss
+            # round selection: test loss when available, else the *pure*
+            # train loss — the regularized loss would always prefer the
+            # smallest penalty (reference compares test loss, :489-500)
+            tl = (
+                float(jit_loss(res.w, *test_b)) if test_b is not None else res.pure_loss
+            )
             if best is None or tl < best[0]:
                 best = (tl, res, l1, l2)
             if len(rounds) > 1:
