@@ -1,0 +1,4 @@
+from .data import GBDTIngest, GBDTData
+from .binning import FeatureBins, build_bins, bin_matrix
+from .tree import Tree, GBDTModel
+from .trainer import GBDTTrainer

@@ -1,0 +1,183 @@
+"""Feature binning — samplers + value->bin conversion.
+
+Rebuild of reference feature/gbdt/approximate/* (SampleManager + 5 samplers)
+and data/gbdt/FeatureApprData.java:179 (convertFeaVal2ApprFeaIndex).
+
+Bins are *representative values*: each feature's sampler emits a set of
+candidate values, sorted; a raw value maps to the NEAREST representative
+(last <=, then pulled down if closer to the previous one — exactly the
+reference's BinarySearch.findLastEqualOrUpper + midpoint adjustment).
+Split "slot s" means bins <= s go left; the dumped split value is the
+mean/median of the two adjacent representatives (feature/gbdt/FeatureSplitType.java).
+
+Samplers (feature/gbdt/approximate/sampler/*):
+  sample_by_quantile   weighted quantiles at max_cnt even ranks, weights
+                       raised to alpha (SampleByQuantile.java:105); the
+                       reference's distributed GK sketch becomes an exact
+                       sort-based weighted quantile on device/host
+  sample_by_cnt        distinct values; if too many, values at max_cnt
+                       uniformly-sampled rows
+  sample_by_rate       distinct values of a Bernoulli(sample_rate) row sample
+                       (if distinct count > min_cnt)
+  sample_by_precision  values rounded to dot_precision decimals after
+                       optional log / min-max normalization, then inverted
+  no_sample            all distinct values (exact greedy)
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import math
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..config.params import ApproximateSpec, GBDTParams
+
+
+@dataclass
+class FeatureBins:
+    """Per-feature sorted representative values, padded to a common width.
+
+    values[f, :counts[f]] are real; padding slots repeat the last value so
+    searchsorted stays monotone."""
+
+    values: np.ndarray  # (F, B) f32 sorted per row
+    counts: np.ndarray  # (F,) int32
+    max_bins: int
+
+    def split_value(self, fid: int, slot: int, split_type: str = "mean") -> float:
+        """Split cond for 'bins <= slot go left' (reference:
+        FeatureSplitType — interval [slot, slot+1])."""
+        v = self.values[fid]
+        cnt = int(self.counts[fid])
+        hi = min(slot + 1, cnt - 1)
+        if split_type == "median":
+            s = slot + hi
+            if s % 2 == 0:
+                return float(v[s // 2])
+            return 0.5 * (float(v[(s - 1) // 2]) + float(v[(s + 1) // 2]))
+        return 0.5 * (float(v[slot]) + float(v[hi]))
+
+
+def _sample_feature(
+    col: np.ndarray, weight: np.ndarray, spec: ApproximateSpec, rng: np.random.RandomState
+) -> np.ndarray:
+    kind = spec.type
+    if kind == "no_sample":
+        return np.unique(col)
+    if kind == "sample_by_cnt":
+        vals = np.unique(col)
+        if len(vals) > spec.max_cnt:
+            picks = rng.choice(len(col), size=spec.max_cnt, replace=False)
+            vals = np.unique(col[picks])
+        return vals
+    if kind == "sample_by_rate":
+        vals = np.unique(col)
+        if len(vals) > spec.min_cnt:
+            mask = rng.rand(len(col)) <= spec.sample_rate
+            if mask.any():
+                vals = np.unique(col[mask])
+        return vals
+    if kind == "sample_by_precision":
+        x = col.astype(np.float64)
+        lo = hi = None
+        if spec.use_min_max:
+            lo, hi = float(x.min()), float(x.max())
+            x = (x - lo) / (hi - lo) if hi > lo else np.zeros_like(x)
+        if spec.use_log:
+            x = np.sign(x) * np.log1p(np.abs(x))
+        r = np.unique(np.round(x, spec.dot_precision))
+        # invert the normalization chain (reference: Sampler.reverse)
+        if spec.use_log:
+            r = np.sign(r) * (np.expm1(np.abs(r)))
+        if spec.use_min_max and lo is not None and hi > lo:
+            r = r * (hi - lo) + lo
+        return np.unique(r.astype(np.float32))
+    if kind == "sample_by_quantile":
+        vals = np.unique(col)
+        if len(vals) <= spec.max_cnt:
+            return vals
+        w = (
+            np.power(np.maximum(weight, 0.0), spec.alpha)
+            if spec.use_sample_weight
+            else np.ones_like(col)
+        )
+        order = np.argsort(col, kind="stable")
+        sv, sw = col[order], w[order]
+        cw = np.cumsum(sw)
+        total = cw[-1]
+        # max_cnt evenly spaced quantile ranks (the GK query points)
+        ranks = (np.arange(1, spec.max_cnt + 1) / spec.max_cnt) * total
+        pos = np.searchsorted(cw, ranks, side="left").clip(0, len(sv) - 1)
+        return np.unique(sv[pos])
+    raise ValueError(f"unknown sampler type: {kind!r}")
+
+
+def _spec_for(fid: int, name: str, specs: Sequence[ApproximateSpec]) -> ApproximateSpec:
+    """Column matching: `cols` is 'default' or a comma list of names/globs
+    (reference: SampleManager sampler assignment)."""
+    default = None
+    for s in specs:
+        if s.cols == "default":
+            default = s
+            continue
+        for pat in str(s.cols).split(","):
+            pat = pat.strip()
+            if pat and (pat == name or fnmatch.fnmatch(name, pat)):
+                return s
+    return default or specs[0]
+
+
+def build_bins(
+    X: np.ndarray,
+    weight: np.ndarray,
+    params: GBDTParams,
+    feature_names: Optional[Sequence[str]] = None,
+    seed: int = 20170425,
+) -> FeatureBins:
+    """Run the configured sampler per feature; pad to a common bin width."""
+    rng = np.random.RandomState(seed)
+    F = X.shape[1]
+    names = feature_names or [str(i) for i in range(F)]
+    per_feature: List[np.ndarray] = []
+    for f in range(F):
+        spec = _spec_for(f, names[f], params.approximate)
+        vals = _sample_feature(X[:, f], weight, spec, rng).astype(np.float32)
+        if len(vals) == 0:
+            vals = np.zeros((1,), np.float32)
+        per_feature.append(np.sort(vals))
+    max_bins = max(len(v) for v in per_feature)
+    values = np.empty((F, max_bins), np.float32)
+    counts = np.empty((F,), np.int32)
+    for f, v in enumerate(per_feature):
+        values[f, : len(v)] = v
+        values[f, len(v):] = v[-1]  # pad with last value (monotone)
+        counts[f] = len(v)
+    return FeatureBins(values=values, counts=counts, max_bins=max_bins)
+
+
+def bin_matrix(X: np.ndarray, bins: FeatureBins) -> np.ndarray:
+    """Raw values -> nearest-representative bin ids, vectorized
+    (reference: FeatureApprData.convertFeaVal2ApprFeaIndex:179).
+
+    rule: i = first index with values[i] >= v (v > max -> last bin);
+          if i >= 1 and v < midpoint(values[i-1], values[i]) -> i-1
+    i.e. round to the nearest representative, ties to the upper one."""
+    n, F = X.shape
+    out = np.empty((n, F), np.int32)
+    for f in range(F):
+        cnt = int(bins.counts[f])
+        v = bins.values[f, :cnt]
+        if cnt == 1:
+            out[:, f] = 0
+            continue
+        col = X[:, f]
+        i = np.searchsorted(v, col, side="left")  # ceil index
+        over = col > v[-1]
+        i = np.clip(i, 0, cnt - 1)
+        mids = 0.5 * (v[np.maximum(i - 1, 0)] + v[i])
+        i = np.where((i >= 1) & (col < mids) & ~over, i - 1, i)
+        out[:, f] = np.where(over, cnt - 1, i)
+    return out
