@@ -1,0 +1,259 @@
+"""GBDT trainer + tree text format tests (mesh8 via conftest).
+
+Covers the round-2 verdict gaps: stats/no-stats dump-load round trips
+(regression for the comma-greedy INNER_RE/LEAF_RE bug), continue_train
+resume, level vs loss growth, multiclass softmax, LAD refine, and
+missing-value default direction. Reference semantics:
+data/gbdt/Tree.java:47-48 (text format), GBDTOptimizer.java:408 (resume),
+TreeRefiner.java:72-123 (LAD), GBDTOptimizer.addFeatureNameInModel
+(default direction from the missing fill value).
+"""
+
+import numpy as np
+import pytest
+
+from ytklearn_tpu.config.params import ApproximateSpec, GBDTParams
+from ytklearn_tpu.gbdt.data import GBDTData, _apply_fill
+from ytklearn_tpu.gbdt.trainer import GBDTTrainer
+from ytklearn_tpu.gbdt.tree import GBDTModel, Tree
+
+
+def make_params(tmp_path, **kw) -> GBDTParams:
+    p = GBDTParams(
+        round_num=3,
+        max_depth=3,
+        max_leaf_cnt=16,
+        learning_rate=0.3,
+        l2=1.0,
+        min_child_hessian_sum=1e-6,
+        eval_metric=["auc"],
+        approximate=[ApproximateSpec(type="sample_by_quantile", max_cnt=32)],
+    )
+    p.model.data_path = str(tmp_path / "model")
+    p.model.dump_freq = 0
+    for k, v in kw.items():
+        setattr(p, k, v)
+    return p
+
+
+def make_binary(n=2000, F=6, seed=0):
+    """Planted axis-aligned signal a depth-2 tree can capture."""
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, F).astype(np.float32)
+    y = ((X[:, 0] > 0.3) | ((X[:, 1] > 0) & (X[:, 2] < 0.5))).astype(np.float32)
+    flip = rng.rand(n) < 0.05
+    y = np.where(flip, 1 - y, y)
+    w = np.ones(n, np.float32)
+    return GBDTData(
+        X=X, y=y, weight=w, n_real=n, feature_names=[str(i) for i in range(F)]
+    )
+
+
+def auc(scores, y):
+    order = np.argsort(scores)
+    ranks = np.empty_like(order, dtype=np.float64)
+    ranks[order] = np.arange(1, len(y) + 1)
+    pos = y > 0.5
+    n1, n0 = pos.sum(), (~pos).sum()
+    return (ranks[pos].sum() - n1 * (n1 + 1) / 2) / (n1 * n0)
+
+
+# ---------------------------------------------------------------------------
+# text format (unit level — the round-2 confirmed bug)
+# ---------------------------------------------------------------------------
+
+
+def test_tree_parse_stats_line():
+    """INNER_RE must not let missing= swallow ,gain=...  (gbdt/tree.py)."""
+    t = Tree()
+    t.feat[0] = 2
+    t.feat_name[0] = "2"
+    t.split[0] = 1.5
+    left, right = t.add_children(0)
+    t.default_left[0] = False
+    t.gain[0] = 1673.3905
+    t.hess_sum[0] = 250.0
+    t.sample_cnt[0] = 1000
+    t.leaf_value[left] = -0.25
+    t.leaf_value[right] = 0.75
+    t.hess_sum[left] = t.hess_sum[right] = 125.0
+    t.sample_cnt[left] = t.sample_cnt[right] = 500
+
+    for with_stats in (True, False):
+        text = t.dump(0, with_stats=with_stats)
+        t2 = Tree.parse(text.split("\n")[1:])
+        assert t2.feat_name[0] == "2"
+        assert t2.split[0] == pytest.approx(1.5)
+        assert t2.left[0] == left and t2.right[0] == right
+        assert t2.default_left[0] is False
+        assert t2.leaf_value[right] == pytest.approx(0.75)
+        if with_stats:
+            assert t2.gain[0] == pytest.approx(1673.3905, rel=1e-6)
+            assert t2.sample_cnt[left] == 500
+
+
+def test_model_roundtrip_bytes_and_predictions(tmp_path, mesh8):
+    data = make_binary()
+    trainer = GBDTTrainer(make_params(tmp_path), mesh=mesh8)
+    res = trainer.train(data)
+    model = res.model
+    assert len(model.trees) == 3
+
+    for with_stats in (True, False):
+        text = model.dumps(with_stats=with_stats)
+        m2 = GBDTModel.loads(text)
+        # byte-level round trip
+        assert m2.dumps(with_stats=with_stats) == text
+        # prediction equality on the training matrix
+        np.testing.assert_allclose(
+            m2.predict_scores(data.X), model.predict_scores(data.X), rtol=1e-6
+        )
+
+
+# ---------------------------------------------------------------------------
+# growth policies
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("policy", ["level", "loss"])
+def test_grow_policy_learns_signal(tmp_path, mesh8, policy):
+    data = make_binary()
+    p = make_params(tmp_path, tree_grow_policy=policy, round_num=5)
+    res = GBDTTrainer(p, mesh=mesh8).train(data)
+    scores = res.model.predict_scores(data.X)
+    assert auc(scores, data.y) > 0.95
+    losses = [r["train_loss"] for r in res.round_log]
+    assert losses[-1] < losses[0]
+
+
+def test_level_and_loss_agree_on_first_split(tmp_path, mesh8):
+    """Both policies must pick the same root split (same gain formula)."""
+    data = make_binary()
+    trees = {}
+    for policy in ("level", "loss"):
+        p = make_params(tmp_path, tree_grow_policy=policy, round_num=1, max_depth=1)
+        res = GBDTTrainer(p, mesh=mesh8).train(data)
+        trees[policy] = res.model.trees[0]
+    a, b = trees["level"], trees["loss"]
+    assert a.feat_name[0] == b.feat_name[0]
+    assert a.split[0] == pytest.approx(b.split[0], rel=1e-6)
+    assert a.leaf_value[a.left[0]] == pytest.approx(b.leaf_value[b.left[0]], rel=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# continue_train resume (reference: GBDTOptimizer.java:408)
+# ---------------------------------------------------------------------------
+
+
+def test_continue_train_resume(tmp_path, mesh8):
+    data = make_binary()
+    p1 = make_params(tmp_path, round_num=3)
+    res1 = GBDTTrainer(p1, mesh=mesh8).train(data)
+    assert len(res1.model.trees) == 3
+
+    p2 = make_params(tmp_path, round_num=6)
+    p2.model.continue_train = True
+    res2 = GBDTTrainer(p2, mesh=mesh8).train(data)
+    assert len(res2.model.trees) == 6
+    assert res2.train_loss < res1.train_loss
+    # the resumed model must still round-trip
+    m = GBDTModel.loads(res2.model.dumps())
+    assert len(m.trees) == 6
+
+
+# ---------------------------------------------------------------------------
+# multiclass softmax: K trees per round, one per class group
+# ---------------------------------------------------------------------------
+
+
+def test_multiclass_softmax(tmp_path, mesh8):
+    rng = np.random.RandomState(1)
+    n, F, K = 1500, 5, 3
+    X = rng.randn(n, F).astype(np.float32)
+    cls = np.argmax(
+        np.stack([X[:, 0], X[:, 1], -(X[:, 0] + X[:, 1])], axis=1), axis=1
+    )
+    y = np.eye(K, dtype=np.float32)[cls]
+    data = GBDTData(
+        X=X, y=y, weight=np.ones(n, np.float32), n_real=n,
+        feature_names=[str(i) for i in range(F)],
+    )
+    p = make_params(
+        tmp_path, loss_function="softmax", class_num=K, round_num=4,
+        eval_metric=["confusion_matrix"],
+    )
+    res = GBDTTrainer(p, mesh=mesh8).train(data)
+    assert len(res.model.trees) == 4 * K
+    assert res.model.num_tree_in_group == K
+    scores = res.model.predict_scores(X)
+    assert scores.shape == (n, K)
+    acc = float((np.argmax(scores, axis=1) == cls).mean())
+    assert acc > 0.85
+
+
+# ---------------------------------------------------------------------------
+# LAD (l1) leaf refinement to the weighted median
+# ---------------------------------------------------------------------------
+
+
+def test_lad_refine(tmp_path, mesh8):
+    rng = np.random.RandomState(2)
+    n, F = 1200, 4
+    X = rng.randn(n, F).astype(np.float32)
+    y = (2.0 * (X[:, 0] > 0) + (X[:, 1] > 0)).astype(np.float32)
+    data = GBDTData(
+        X=X, y=y, weight=np.ones(n, np.float32), n_real=n,
+        feature_names=[str(i) for i in range(F)],
+    )
+    p = make_params(
+        tmp_path, loss_function="l1", round_num=6, learning_rate=0.5,
+        eval_metric=["mae"], uniform_base_prediction=1.0,
+    )
+    res = GBDTTrainer(p, mesh=mesh8).train(data)
+    losses = [r["train_loss"] for r in res.round_log]
+    assert losses[-1] < losses[0]
+    assert res.train_loss < 0.4  # MAE well below the 0.75-ish constant predictor
+
+
+# ---------------------------------------------------------------------------
+# missing values: fill + default direction at predict time
+# ---------------------------------------------------------------------------
+
+
+def test_missing_default_direction(tmp_path, mesh8):
+    data = make_binary(n=2500)
+    rng = np.random.RandomState(3)
+    X_nan = data.X.copy()
+    mask = rng.rand(*X_nan.shape) < 0.15
+    X_nan[mask] = np.nan
+
+    fill = np.nanmean(X_nan, axis=0).astype(np.float32)
+    X_filled = X_nan.copy()
+    _apply_fill(X_filled, fill)
+    train = GBDTData(
+        X=X_filled, y=data.y, weight=data.weight, n_real=data.n_real,
+        feature_names=data.feature_names, missing_fill=fill,
+    )
+    res = GBDTTrainer(make_params(tmp_path, round_num=4), mesh=mesh8).train(train)
+    model = res.model
+
+    # default direction recorded: NaN routes where the fill value would go
+    any_inner = False
+    for t in model.trees:
+        for nid in range(t.n_nodes()):
+            if not t.is_leaf(nid):
+                any_inner = True
+                fid = int(t.feat_name[nid])
+                assert t.default_left[nid] == (fill[fid] <= t.split[nid])
+    assert any_inner
+
+    # predicting with NaNs == predicting with the fill value substituted
+    np.testing.assert_allclose(
+        model.predict_scores(X_nan), model.predict_scores(X_filled), rtol=1e-6
+    )
+
+    # and it must survive a text round trip
+    m2 = GBDTModel.loads(model.dumps())
+    np.testing.assert_allclose(
+        m2.predict_scores(X_nan), model.predict_scores(X_nan), rtol=1e-6
+    )

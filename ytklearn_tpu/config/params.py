@@ -18,21 +18,32 @@ from . import hocon
 from .hocon import MISSING, get_path
 
 
+# The reference's stock configs use ??? (sometimes quoted "???") as a
+# fill-me-in placeholder (config/model/gbdt.conf:41). Unquoted ??? already
+# coerces to MISSING in the hocon parser; quoted "???" arrives as a string,
+# so treat it as unset here too — for every field, not just paths —
+# rather than e.g. writing a model to a file literally named ???.
+
+
 def _req(cfg: dict, path: str):
     v = get_path(cfg, path, MISSING)
-    if v is MISSING:
+    if v is MISSING or v == "???":
         raise ValueError(f"config value {path!r} is required but unset (???)")
     return v
 
 
 def _opt(cfg: dict, path: str, default):
     v = get_path(cfg, path, default)
-    return default if v is MISSING else v
+    return default if v is MISSING or v == "???" else v
+
+
+def _opt_path(cfg: dict, path: str) -> str:
+    return str(_opt(cfg, path, "") or "")
 
 
 def _as_paths(v) -> List[str]:
     """data_path may be a single string or a list; comma-split like the
-    reference's multi-path handling."""
+    reference's multi-path handling. "???" placeholders drop out."""
     if v is None or v is MISSING or v == "":
         return []
     if isinstance(v, (list, tuple)):
@@ -40,7 +51,7 @@ def _as_paths(v) -> List[str]:
         for x in v:
             out.extend(_as_paths(x))
         return out
-    return [p for p in str(v).split(",") if p]
+    return [p for p in str(v).split(",") if p and p != "???"]
 
 
 @dataclass
@@ -85,7 +96,7 @@ class DataParams:
             label, rate = str(s).split("@")
             ys.append((label, float(rate)))
         return cls(
-            train_paths=_as_paths(get_path(cfg, "data.train.data_path")),
+            train_paths=_as_paths(_opt(cfg, "data.train.data_path", "")),
             train_max_error_tol=int(_opt(cfg, "data.train.max_error_tol", 0)),
             test_paths=_as_paths(_opt(cfg, "data.test.data_path", "")),
             test_max_error_tol=int(_opt(cfg, "data.test.max_error_tol", 0)),
@@ -171,18 +182,17 @@ class ModelParams:
 
     @classmethod
     def from_config(cls, cfg: dict) -> "ModelParams":
-        fip = _opt(cfg, "model.feature_importance_path", "")
         return cls(
             data_path=str(_req(cfg, "model.data_path")),
             delim=str(_opt(cfg, "model.delim", ",")),
             need_dict=bool(_opt(cfg, "model.need_dict", False)),
-            dict_path=str(_opt(cfg, "model.dict_path", "") or ""),
+            dict_path=_opt_path(cfg, "model.dict_path"),
             dump_freq=int(_opt(cfg, "model.dump_freq", 50)),
             need_bias=bool(_opt(cfg, "model.need_bias", True)),
             bias_feature_name=str(_opt(cfg, "model.bias_feature_name", "_bias_")),
             continue_train=bool(_opt(cfg, "model.continue_train", False)),
-            field_dict_path=str(_opt(cfg, "model.field_dict_path", "") or ""),
-            feature_importance_path=str(fip or ""),
+            field_dict_path=_opt_path(cfg, "model.field_dict_path"),
+            feature_importance_path=_opt_path(cfg, "model.feature_importance_path"),
         )
 
 

@@ -24,11 +24,18 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+# Reference Tree.java:47-48 uses greedy \S+ everywhere but its stats fields
+# are mandatory, so the regex engine backtracks into place. Ours makes the
+# stats suffix OPTIONAL (dump(with_stats=False) support), so every capture
+# that a comma terminates must be comma-safe or `missing=` swallows
+# ",gain=...,hess_sum=..." whole.
 INNER_RE = re.compile(
-    r"(\S+):\[f_(\S+)<=(\S+)\] yes=(\S+),no=(\S+),missing=(\S+)"
-    r"(?:,gain=(\S+),hess_sum=(\S+),sample_cnt=(\S+))?"
+    r"(\S+):\[f_(\S+)<=(\S+)\] yes=([^,\s]+),no=([^,\s]+),missing=([^,\s]+)"
+    r"(?:,gain=([^,\s]+),hess_sum=([^,\s]+),sample_cnt=([^,\s]+))?"
 )
-LEAF_RE = re.compile(r"(\S+):leaf=(\S+)(?:,hess_sum=(\S+),sample_cnt=(\S+))?")
+LEAF_RE = re.compile(
+    r"(\S+):leaf=([^,\s]+)(?:,hess_sum=([^,\s]+),sample_cnt=([^,\s]+))?"
+)
 
 
 @dataclass
