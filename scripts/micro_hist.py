@@ -1,0 +1,144 @@
+"""Micro-benchmark histogram strategies on the real chip.
+
+Candidates for hist[node, f, bin, ch] accumulation (the GBDT hot kernel):
+  scatter   — current .at[].add scatter (baseline)
+  dense     — (P*val).T @ onehot(bins) two-matmul, full MXU tiles, no sort
+  blockdot  — sort-by-node + padded node-aligned blocks; per-block
+              onehot(bins).T @ vals dot, then per-block add into node slot
+Also measures: dispatch round-trip latency, device sort, row gather.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def timeit(fn, *args, reps=5):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps
+
+
+@partial(jax.jit, static_argnames=("n_nodes", "F", "B"))
+def hist_scatter(bins, pos, g, h, n_nodes: int, F: int, B: int):
+    n = bins.shape[0]
+    active = pos >= 0
+    base = jnp.where(active, pos, n_nodes) * (F * B)
+    ids = base[:, None] + jnp.arange(F)[None, :] * B + bins
+    vals = jnp.stack([g, h, jnp.where(active, 1.0, 0.0)], axis=1)
+    flat = jnp.zeros(((n_nodes + 1) * F * B, 3), jnp.float32)
+    flat = flat.at[ids.reshape(-1)].add(
+        jnp.repeat(vals, F, axis=0).reshape(n, F, 3).reshape(-1, 3)
+    )
+    return flat[: n_nodes * F * B].reshape(n_nodes, F, B, 3)
+
+
+@partial(jax.jit, static_argnames=("n_nodes", "B", "dtype"))
+def hist_dense(bins, pos, g, h, n_nodes: int, B: int, dtype):
+    """(P ⊙ val).T @ onehot(bins_f) per channel; batched over F via einsum.
+
+    P: (n, N) one-hot of node; OH: (n, F, B) one-hot of bins — both fused
+    compare-iota producers, never materialized at full size if XLA fuses.
+    """
+    active = pos >= 0
+    P = (pos[:, None] == jnp.arange(n_nodes)[None, :]).astype(dtype)  # (n, N)
+    OH = (bins[:, :, None] == jnp.arange(B)[None, None, :]).astype(dtype)  # (n,F,B)
+    vals = jnp.stack([g, h, jnp.where(active, 1.0, 0.0)], axis=1).astype(dtype)
+    PV = P[:, :, None] * vals[:, None, :]  # (n, N, 3)
+    out = jnp.einsum(
+        "nxc,nfb->xfbc", PV, OH, preferred_element_type=jnp.float32
+    )
+    return out
+
+
+@partial(jax.jit, static_argnames=("B", "dtype", "bm"))
+def hist_blockdot(bins_sorted, vals_sorted, B: int, dtype, bm: int):
+    """Per-block onehot.T @ vals. bins_sorted (n_pad, F) already gathered in
+    node order with node-aligned bm-padding; vals_sorted (n_pad, 3), zeros
+    at padding. Returns per-block hists (nblk, F, B, 3)."""
+    n_pad, F = bins_sorted.shape
+    nblk = n_pad // bm
+    bb = bins_sorted.reshape(nblk, bm, F)
+    vv = vals_sorted.reshape(nblk, bm, 3).astype(dtype)
+    OH = (bb[..., None] == jnp.arange(B)[None, None, None, :]).astype(dtype)
+    out = jnp.einsum(
+        "kmfb,kmc->kfbc", OH, vv, preferred_element_type=jnp.float32
+    )
+    return out
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+    F, B, N = 28, 256, 128
+    rng = np.random.RandomState(0)
+    bins = jnp.asarray(rng.randint(0, 255, size=(n, F)).astype(np.int8))
+    bins32 = bins.astype(jnp.int32)
+    pos = jnp.asarray(rng.randint(0, N, size=(n,)).astype(np.int32))
+    g = jnp.asarray(rng.randn(n).astype(np.float32))
+    h = jnp.asarray(np.abs(rng.randn(n)).astype(np.float32))
+
+    # dispatch latency
+    f_id = jax.jit(lambda x: x + 1)
+    x = jnp.zeros((8,), jnp.float32)
+    f_id(x)
+    t = timeit(f_id, x, reps=20)
+    print(f"dispatch+tiny-op round trip: {t*1e3:.2f} ms")
+
+    # device->host scalar transfer
+    y = jnp.ones((), jnp.float32)
+    t0 = time.perf_counter()
+    for _ in range(20):
+        float(y)
+    print(f"scalar device->host: {(time.perf_counter()-t0)/20*1e3:.2f} ms")
+
+    # sort by node
+    srt = jax.jit(lambda p: jax.lax.sort_key_val(p, jnp.arange(p.shape[0])))
+    t = timeit(srt, pos, reps=3)
+    print(f"sort {n} keys: {t*1e3:.1f} ms")
+
+    # row gather (n, F)
+    _, order = srt(pos)
+    gat = jax.jit(lambda b, o: b[o])
+    t = timeit(gat, bins, order, reps=3)
+    print(f"row gather (n,{F}) int8: {t*1e3:.1f} ms")
+
+    if n <= 2_000_000:
+        t = timeit(hist_scatter, bins32, pos, g, h, N, F, B, reps=2)
+        print(f"scatter  N={N}: {t*1e3:.1f} ms")
+
+    for dt_name, dt in [("bf16", jnp.bfloat16), ("f32", jnp.float32)]:
+        for NN in (8, 128):
+            try:
+                t = timeit(hist_dense, bins, pos % NN, g, h, NN, B, dt, reps=2)
+                print(f"dense    N={NN} {dt_name}: {t*1e3:.1f} ms")
+            except Exception as e:
+                print(f"dense    N={NN} {dt_name}: FAILED {type(e).__name__}: {e}")
+
+    vals = jnp.stack([g, h, jnp.ones_like(g)], axis=1)
+    n_pad = (n + 511) // 512 * 512
+    bins_s = jnp.zeros((n_pad, F), jnp.int8).at[:n].set(bins)
+    vals_s = jnp.zeros((n_pad, 3), jnp.float32).at[:n].set(vals)
+    for dt_name, dt in [("bf16", jnp.bfloat16), ("f32", jnp.float32)]:
+        for bm in (512, 1024, 2048):
+            npd = (n + bm - 1) // bm * bm
+            try:
+                t = timeit(
+                    hist_blockdot, bins_s[:npd], vals_s[:npd], B, dt, bm, reps=2
+                )
+                print(f"blockdot bm={bm} {dt_name}: {t*1e3:.1f} ms")
+            except Exception as e:
+                print(f"blockdot bm={bm} {dt_name}: FAILED {type(e).__name__}: {e}")
+
+
+if __name__ == "__main__":
+    main()

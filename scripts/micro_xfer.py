@@ -1,0 +1,56 @@
+"""Characterize host<->device transfer costs through the axon tunnel."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main():
+    # D2H: different sizes
+    for shape in [(), (100,), (100_000,), (10_000_000,)]:
+        x = jnp.ones(shape, jnp.float32)
+        jax.block_until_ready(x)
+        np.asarray(x)  # warm
+        t0 = time.perf_counter()
+        reps = 5
+        for _ in range(reps):
+            np.asarray(x)
+        dt = (time.perf_counter() - t0) / reps
+        nbytes = int(np.prod(shape or (1,))) * 4
+        print(f"D2H {str(shape):>14} {nbytes/1e6:9.2f} MB: {dt*1e3:8.1f} ms")
+
+    # D2H: pytree of 10 small arrays via device_get (batched?)
+    tree = [jnp.ones((10,), jnp.float32) * i for i in range(10)]
+    jax.block_until_ready(tree)
+    jax.device_get(tree)
+    t0 = time.perf_counter()
+    for _ in range(3):
+        jax.device_get(tree)
+    print(f"D2H pytree of 10 small arrays: {(time.perf_counter()-t0)/3*1e3:.1f} ms")
+
+    # H2D
+    for shape in [(100,), (10_000_000,)]:
+        x_np = np.ones(shape, np.float32)
+        jax.block_until_ready(jax.device_put(x_np))
+        t0 = time.perf_counter()
+        for _ in range(3):
+            jax.block_until_ready(jax.device_put(x_np))
+        dt = (time.perf_counter() - t0) / 3
+        print(f"H2D {str(shape):>14} {x_np.nbytes/1e6:9.2f} MB: {dt*1e3:8.1f} ms")
+
+    # does an async dispatch chain pipeline? 100 chained matmuls, one sync
+    a = jnp.ones((1024, 1024), jnp.float32)
+    f = jax.jit(lambda x: x @ x / 1024.0)
+    f(a).block_until_ready()
+    t0 = time.perf_counter()
+    x = a
+    for _ in range(100):
+        x = f(x)
+    jax.block_until_ready(x)
+    print(f"100 chained jit matmuls (1 sync): {(time.perf_counter()-t0)*1e3:.1f} ms")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,75 @@
+"""Profile the GBDT trainer at Higgs-like scale on the real chip.
+
+Measures trees/sec for level-wise and loss-wise growth at the acceptance
+config (255 bins, 255 leaves loss-wise / depth-8 level-wise) on synthetic
+11M x 28 data, so we know where the time goes before optimizing.
+
+Usage: python scripts/profile_gbdt.py [n_rows] [n_trees] [policy]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+    n_trees = int(sys.argv[2]) if len(sys.argv) > 2 else 5
+    policy = sys.argv[3] if len(sys.argv) > 3 else "loss"
+
+    from ytklearn_tpu.config.params import ApproximateSpec, GBDTParams, ModelParams
+    from ytklearn_tpu.gbdt.data import GBDTData
+    from ytklearn_tpu.gbdt.trainer import GBDTTrainer
+
+    F = 28
+    rng = np.random.RandomState(0)
+    X = rng.randn(n, F).astype(np.float32)
+    # planted nonlinear signal so trees have something to split on
+    logit = (
+        1.5 * X[:, 0] * X[:, 1]
+        + np.sin(X[:, 2] * 2)
+        + 0.8 * (X[:, 3] > 0.5)
+        - 0.5 * X[:, 4] ** 2
+    )
+    y = (logit + rng.randn(n) * 0.5 > 0).astype(np.float32)
+
+    params = GBDTParams(
+        round_num=n_trees,
+        max_depth=8 if policy == "level" else 100,
+        max_leaf_cnt=255,
+        tree_grow_policy=policy,
+        learning_rate=0.1,
+        min_child_hessian_sum=100.0,
+        loss_function="sigmoid",
+        eval_metric=[],
+        watch_train=False,
+        watch_test=False,
+        approximate=[ApproximateSpec(max_cnt=255)],
+        model=ModelParams(data_path="/tmp/profile_gbdt_model", dump_freq=0),
+    )
+    data = GBDTData(
+        X=X,
+        y=y,
+        weight=np.ones(n, np.float32),
+        n_real=n,
+        feature_names=[f"f{i}" for i in range(F)],
+    )
+
+    trainer = GBDTTrainer(params)
+    t0 = time.time()
+    res = trainer.train(train=data, test=None)
+    dt = time.time() - t0
+    n_built = len(res.model.trees)
+    print(
+        f"policy={policy} rows={n} trees={n_built} total={dt:.1f}s "
+        f"trees/s={n_built / dt:.3f} train_loss={res.train_loss:.5f}"
+    )
+    for rec in res.round_log:
+        print(f"  round {rec['round']}: cum {rec['elapsed']:.1f}s")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,321 @@
+"""Predictor stack: train-time vs served-score parity for every family.
+
+Each test trains briefly on demo-sized data, then reloads the dumped text
+model through create_predictor and asserts the served predictions match
+the trainer's in-memory predictions row by row (reference:
+predictor/OnlinePredictor.java surface, ContinuousOnlinePredictor.java:54,
+GBDTOnlinePredictor.java:258)."""
+
+import numpy as np
+import pytest
+
+from ytklearn_tpu.config import hocon
+from ytklearn_tpu.config.params import CommonParams, GBDTParams
+from ytklearn_tpu.predict import (
+    batch_predict_from_files,
+    create_predictor,
+    parse_feature_kvs,
+)
+from ytklearn_tpu.train import HoagTrainer
+
+REF = "/root/reference"
+
+
+def _cfg(conf, tmp_path, train, test="", **over):
+    cfg = hocon.load(conf)
+    cfg = hocon.set_path(cfg, "data.train.data_path", train)
+    cfg = hocon.set_path(cfg, "data.test.data_path", test)
+    cfg = hocon.set_path(cfg, "model.data_path", str(tmp_path / "m.model"))
+    for k, v in over.items():
+        cfg = hocon.set_path(cfg, k, v)
+    return cfg
+
+
+def _rows(path, delim, limit=20):
+    """(feature dict, label text, raw line) per data line."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            parts = line.split(delim.x_delim)
+            out.append((parse_feature_kvs(parts[2], delim), parts[1], line))
+            if len(out) >= limit:
+                break
+    return out
+
+
+def test_linear_predictor_parity(tmp_path):
+    cfg = _cfg(
+        f"{REF}/demo/linear/binary_classification/linear.conf",
+        tmp_path,
+        f"{REF}/demo/data/ytklearn/agaricus.train.ytklearn",
+        f"{REF}/demo/data/ytklearn/agaricus.test.ytklearn",
+        **{"optimization.line_search.lbfgs.convergence.max_iter": 10},
+    )
+    p = CommonParams.from_config(cfg)
+    res = HoagTrainer(p, "linear").train()
+
+    pred = create_predictor("linear", cfg)
+    rows = _rows(f"{REF}/demo/data/ytklearn/agaricus.test.ytklearn", p.data.delim)
+
+    # parity vs the trained weights through the training-side kernel
+    from ytklearn_tpu.io.reader import DataIngest
+
+    ing = DataIngest(p).load()
+    got = [pred.predict(fmap) for fmap, _, _ in rows]
+    # reconstruct the same rows through the ingest pipeline
+    from ytklearn_tpu.models.linear import LinearModel
+
+    model = LinearModel(p, ing.train.dim)
+    b = model.make_batch(ing.test)
+    want = np.asarray(model.predicts(res.w, *b))[: len(rows)]
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+    # loss + thompson sampling sanity
+    lv = pred.loss_value(rows[0][0], float(rows[0][1]))
+    assert np.isfinite(lv)
+    ts = pred.thompson_sampling_predict(rows[0][0], alpha=0.1)
+    assert 0.0 <= ts <= 1.0
+    t0 = pred.thompson_sampling_predict(rows[0][0], alpha=0.0)
+    assert t0 == pytest.approx(pred.predict(rows[0][0]), abs=1e-9)
+
+
+def test_linear_batch_predict_files(tmp_path):
+    cfg = _cfg(
+        f"{REF}/demo/linear/binary_classification/linear.conf",
+        tmp_path,
+        f"{REF}/demo/data/ytklearn/agaricus.train.ytklearn",
+        "",
+        **{"optimization.line_search.lbfgs.convergence.max_iter": 5},
+    )
+    p = CommonParams.from_config(cfg)
+    HoagTrainer(p, "linear").train()
+
+    src = open(f"{REF}/demo/data/ytklearn/agaricus.test.ytklearn").read().splitlines()
+    pred = create_predictor("linear", cfg)
+    for mode, cols in [
+        ("predict_result_only", 1),
+        ("label_and_predict", 2),
+        ("predict_as_feature", 3),
+    ]:
+        # fresh dir per mode: results land next to inputs (reference
+        # semantics), so a shared dir would feed outputs back as inputs
+        pdir = tmp_path / f"pred_in_{mode}"
+        pdir.mkdir()
+        (pdir / "part-0").write_text("\n".join(src[:50]) + "\n")
+        avg_loss = batch_predict_from_files(
+            pred,
+            "linear",
+            str(pdir),
+            result_save_mode=mode,
+            result_file_suffix=f"_{mode}",
+            eval_metric_str="auc",
+        )
+        assert avg_loss > 0
+        out = (pdir / f"part-0_{mode}").read_text().strip().split("\n")
+        assert len(out) == 50
+        assert len(out[0].split("###")) == cols
+
+    # predict_as_feature appends model_label_0 kv to the feature block
+    line = (
+        tmp_path / "pred_in_predict_as_feature" / "part-0_predict_as_feature"
+    ).read_text().split("\n")[0]
+    assert "linear_label_0:" in line
+
+
+def test_multiclass_predictor_parity(tmp_path):
+    cfg = _cfg(
+        f"{REF}/demo/multiclass_linear/multiclass_linear.conf",
+        tmp_path,
+        f"{REF}/demo/data/ytklearn/dermatology.train.ytklearn",
+        "",
+        **{"optimization.line_search.lbfgs.convergence.max_iter": 15},
+    )
+    p = CommonParams.from_config(cfg)
+    res = HoagTrainer(p, "multiclass_linear").train()
+
+    pred = create_predictor("multiclass_linear", cfg)
+    rows = _rows(f"{REF}/demo/data/ytklearn/dermatology.train.ytklearn", p.data.delim)
+
+    from ytklearn_tpu.io.reader import DataIngest
+    from ytklearn_tpu.models.multiclass import MulticlassLinearModel
+
+    ing = DataIngest(p, n_labels=6).load()
+    model = MulticlassLinearModel(p, ing.train.dim)
+    b = model.make_batch(ing.train)
+    want = np.asarray(model.predicts(res.w, *b))
+    for i, (fmap, _, _) in enumerate(rows):
+        got = pred.predicts(fmap)
+        assert len(got) == 6
+        np.testing.assert_allclose(got, want[i], rtol=2e-4, atol=2e-5)
+
+
+def test_fm_predictor_parity(tmp_path):
+    cfg = _cfg(
+        f"{REF}/demo/fm/binary_classification/fm.conf",
+        tmp_path,
+        f"{REF}/demo/data/ytklearn/agaricus.train.ytklearn",
+        "",
+        **{"optimization.line_search.lbfgs.convergence.max_iter": 8},
+    )
+    p = CommonParams.from_config(cfg)
+    res = HoagTrainer(p, "fm").train()
+
+    pred = create_predictor("fm", cfg)
+    rows = _rows(f"{REF}/demo/data/ytklearn/agaricus.train.ytklearn", p.data.delim)
+
+    from ytklearn_tpu.io.reader import DataIngest
+    from ytklearn_tpu.models.fm import FMModel
+
+    import jax.numpy as jnp
+
+    ing = DataIngest(p).load()
+    model = FMModel(p, ing.train.dim)
+    b = model.make_batch(ing.train)
+    want = np.asarray(model.predicts(jnp.asarray(res.w), *b))
+    got = [pred.predict(fmap) for fmap, _, _ in rows]
+    np.testing.assert_allclose(got, want[: len(rows)], rtol=2e-3, atol=2e-4)
+
+
+def test_ffm_predictor_parity(tmp_path):
+    cfg = _cfg(
+        f"{REF}/demo/ffm/binary_classification/ffm.conf",
+        tmp_path,
+        f"{REF}/demo/data/ytklearn/agaricus.train.ytklearn",
+        "",
+        **{
+            "model.field_dict_path": f"{REF}/demo/ffm/binary_classification/field.dict",
+            "optimization.line_search.lbfgs.convergence.max_iter": 6,
+        },
+    )
+    p = CommonParams.from_config(cfg)
+    res = HoagTrainer(p, "ffm").train()
+
+    pred = create_predictor("ffm", cfg)
+    rows = _rows(f"{REF}/demo/data/ytklearn/agaricus.train.ytklearn", p.data.delim)
+
+    from ytklearn_tpu.io.reader import DataIngest
+    from ytklearn_tpu.models.ffm import FFMModel, load_field_dict
+    from ytklearn_tpu.io.fs import LocalFileSystem
+
+    fmap_fields = load_field_dict(LocalFileSystem(), p.model.field_dict_path)
+    ing = DataIngest(p, field_map=fmap_fields).load()
+    import jax.numpy as jnp
+
+    model = FFMModel(p, ing.train.dim, n_fields=len(fmap_fields))
+    b = model.make_batch(ing.train)
+    want = np.asarray(model.predicts(jnp.asarray(res.w), *b))
+    got = [pred.predict(fmap) for fmap, _, _ in rows]
+    np.testing.assert_allclose(got, want[: len(rows)], rtol=2e-3, atol=2e-4)
+
+
+def test_gbdt_predictor_parity(tmp_path):
+    from ytklearn_tpu.gbdt.data import GBDTData
+    from ytklearn_tpu.gbdt.trainer import GBDTTrainer
+
+    rng = np.random.RandomState(7)
+    n, F = 800, 6
+    X = rng.randn(n, F).astype(np.float32)
+    y = ((X[:, 0] * X[:, 1] + X[:, 2] > 0)).astype(np.float32)
+
+    cfg = _cfg(
+        f"{REF}/config/model/gbdt.conf",
+        tmp_path,
+        "unused",
+        "",
+        **{
+            "data.max_feature_dim": F,
+            "optimization.round_num": 4,
+            "optimization.max_depth": 4,
+            "optimization.eval_metric": [],
+            "optimization.watch_train": False,
+        },
+    )
+    params = GBDTParams.from_config(cfg)
+    data = GBDTData(
+        X=X, y=y, weight=np.ones(n, np.float32), n_real=n,
+        feature_names=[str(i) for i in range(F)],
+    )
+    trainer = GBDTTrainer(params)
+    res = trainer.train(train=data)
+
+    pred = create_predictor("gbdt", cfg)
+    want_scores = res.model.predict_scores(X[:30])
+    want = np.asarray(trainer.loss.predict(want_scores))
+    for i in range(30):
+        fmap = {str(f): float(X[i, f]) for f in range(F)}
+        got = pred.predict(fmap)
+        assert got == pytest.approx(float(want[i]), rel=2e-4, abs=2e-5)
+
+    # leaf prediction: one id per tree, and a valid leaf of that tree
+    leaves = pred.predict_leaf({str(f): float(X[0, f]) for f in range(F)})
+    assert len(leaves) == len(res.model.trees)
+    for t, nid in zip(res.model.trees, leaves):
+        assert t.is_leaf(nid)
+
+    # absent feature routes to the default (missing) child, not a crash
+    partial = {str(f): float(X[0, f]) for f in range(F - 1)}
+    assert np.isfinite(pred.predict(partial))
+
+
+def test_gbst_predictor_parity(tmp_path):
+    from ytklearn_tpu.boost import GBSTTrainer
+
+    rng = np.random.RandomState(3)
+    lines = []
+    for _ in range(400):
+        a, b = rng.randn(), rng.randn()
+        y = int(a * b > 0)
+        lines.append(f"1###{y}###fa:{a:.4f},fb:{b:.4f}")
+    data = tmp_path / "xor.ytk"
+    data.write_text("\n".join(lines) + "\n")
+
+    for variant in ("gbmlr", "gbsdt", "gbhmlr", "gbhsdt"):
+        conf = f"{REF}/demo/{variant}/binary_classification/{variant}.conf"
+        cfg = _cfg(
+            conf,
+            tmp_path / variant,
+            str(data),
+            "",
+            **{
+                "tree_num": 2,
+                "optimization.line_search.lbfgs.convergence.max_iter": 6,
+            },
+        )
+        (tmp_path / variant).mkdir(exist_ok=True)
+        p = CommonParams.from_config(cfg)
+        trainer = GBSTTrainer(p, variant)
+        trainer.train()
+
+        # independent replay through the training-side jnp kernels
+        from ytklearn_tpu.io.fs import LocalFileSystem
+        from ytklearn_tpu.io.reader import DataIngest
+        from ytklearn_tpu.losses import create_loss
+        from ytklearn_tpu.models.gbst import GBSTModel
+
+        ing = DataIngest(p).load()
+        model = GBSTModel(p, ing.train.dim, variant)
+        fs = LocalFileSystem()
+        loss_fn = create_loss(p.loss.loss_function)
+        base = float(loss_fn.pred2score(p.uniform_base_prediction))
+        idx, val = ing.train.idx, ing.train.val
+        full_mask = np.ones(ing.train.dim, np.float32)
+        z = np.full(ing.train.n, base, np.float32)
+        for t in range(2):
+            wt = model.load_tree(fs, ing.feature_map, t)
+            assert wt is not None
+            z = z + p.learning_rate * np.asarray(
+                model.tree_output(wt, idx, val, full_mask)
+            )
+        want = np.asarray(loss_fn.predict(z))
+
+        pred = create_predictor(variant, cfg)
+        rows = _rows(str(data), p.data.delim, limit=25)
+        got = np.asarray([pred.predict(fmap) for fmap, _, _ in rows])
+        np.testing.assert_allclose(got, want[: len(rows)], rtol=2e-3, atol=2e-4)
+
+        leaves = pred.predict_leaf(rows[0][0])
+        assert len(leaves) == 2
+        assert all(0 <= l < int(p.k) for l in leaves)

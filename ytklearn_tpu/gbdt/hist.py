@@ -1,0 +1,152 @@
+"""Histogram accumulation kernels — the GBDT hot op, TPU-first.
+
+The reference's hottest loop (HistogramBuilder.java:72-90) scatter-adds
+(g, h, 1) into per-(node, feature, bin) slots. XLA scatter serializes on
+TPU (measured ~1.7 s per 1M-row pass), so the TPU path instead computes
+the histogram as a blocked one-hot matmul on the MXU:
+
+    for each (feature, sample-block) grid step:
+        P  (N, bm) = node one-hot       # VPU compare: ids col vs pos row
+        OH (B, bm) = bin one-hot        # VPU compare: bin iota vs bins row
+        hist_g (N, B) += (P * g) @ OH.T # MXU NT-dot, f32 accumulation
+        hist_h (N, B) += (P * h) @ OH.T
+        hist_c (N, B) += P @ OH.T
+
+All per-sample arrays ride as (nblk, bm) row-major chunks so every VMEM
+block is a full-lane (1, bm) vector — no (x, 1) lane-padding blowups, no
+in-kernel transposes. Samples whose pos is not in `node_ids` (including
+pos = -1 dead rows) match no one-hot row and vanish.
+
+A dense-einsum fallback provides the same math on CPU (tests run on the
+virtual mesh with JAX_PLATFORMS=cpu where Mosaic kernels can't compile).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _pad_to(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+@partial(jax.jit, static_argnames=("B", "bm", "use_bf16"))
+def _hist_pallas(bins_t, pos, g, h, node_ids, B: int, bm: int, use_bf16: bool):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    F, n = bins_t.shape
+    N = node_ids.shape[0]
+    nblk = n // bm
+    cdt = jnp.bfloat16 if use_bf16 else jnp.float32
+
+    bins3 = bins_t.reshape(F, nblk, 1, bm)
+    pos2 = pos.reshape(nblk, 1, bm)
+    g2 = g.reshape(nblk, 1, bm)
+    h2 = h.reshape(nblk, 1, bm)
+    ids2 = node_ids.reshape(N, 1)
+
+    def kernel(bins_ref, pos_ref, g_ref, h_ref, ids_ref, out_ref):
+        blk = pl.program_id(1)
+        b = bins_ref[0, 0, 0, :][None, :]  # (1, bm) lanes
+        p = pos_ref[0, 0, :][None, :]  # (1, bm)
+        P = (ids_ref[:, 0:1] == p).astype(cdt)  # (N, bm)
+        OH = (
+            jax.lax.broadcasted_iota(jnp.int32, (B, 1), 0) == b
+        ).astype(cdt)  # (B, bm)
+        gv = g_ref[0, 0, :][None, :].astype(cdt)  # (1, bm)
+        hv = h_ref[0, 0, :][None, :].astype(cdt)
+
+        nt = (((1,), (1,)), ((), ()))  # A @ B.T
+        hg = jax.lax.dot_general(P * gv, OH, nt, preferred_element_type=jnp.float32)
+        hh = jax.lax.dot_general(P * hv, OH, nt, preferred_element_type=jnp.float32)
+        hc = jax.lax.dot_general(P, OH, nt, preferred_element_type=jnp.float32)
+        acc = jnp.concatenate([hg, hh, hc], axis=0)  # (3N, B)
+
+        @pl.when(blk == 0)
+        def _():
+            out_ref[0, :, :] = acc
+
+        @pl.when(blk > 0)
+        def _():
+            out_ref[0, :, :] = out_ref[0, :, :] + acc
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(F, nblk),
+        in_specs=[
+            pl.BlockSpec((1, 1, 1, bm), lambda f, k: (f, k, 0, 0)),
+            pl.BlockSpec((1, 1, bm), lambda f, k: (k, 0, 0)),
+            pl.BlockSpec((1, 1, bm), lambda f, k: (k, 0, 0)),
+            pl.BlockSpec((1, 1, bm), lambda f, k: (k, 0, 0)),
+            pl.BlockSpec((N, 1), lambda f, k: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 3 * N, B), lambda f, k: (f, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((F, 3 * N, B), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+    )(bins3, pos2, g2, h2, ids2)
+    return out  # (F, 3N, B), rows [g*N | h*N | c*N]
+
+
+@partial(jax.jit, static_argnames=("B", "use_bf16"))
+def _hist_dense(bins_t, pos, g, h, node_ids, B: int, use_bf16: bool):
+    """Same math as the Pallas kernel via einsum (CPU / fallback path)."""
+    cdt = jnp.bfloat16 if use_bf16 else jnp.float32
+    P = (node_ids[:, None] == pos[None, :]).astype(cdt)  # (N, n)
+    OH = (
+        bins_t[:, None, :] == jnp.arange(B)[None, :, None]
+    ).astype(cdt)  # (F, B, n)
+    gv = g.astype(cdt)
+    hv = h.astype(cdt)
+    hg = jnp.einsum("xn,fbn->fxb", P * gv[None, :], OH, preferred_element_type=jnp.float32)
+    hh = jnp.einsum("xn,fbn->fxb", P * hv[None, :], OH, preferred_element_type=jnp.float32)
+    hc = jnp.einsum("xn,fbn->fxb", P, OH, preferred_element_type=jnp.float32)
+    return jnp.concatenate([hg, hh, hc], axis=1)  # (F, 3N, B)
+
+
+def hist_wave(
+    bins_t,
+    pos,
+    g,
+    h,
+    node_ids,
+    B: int,
+    bm: int = 8192,
+    use_bf16: bool = True,
+    force_dense: bool = False,
+):
+    """(N, F, B, 3) histograms for the nodes listed in `node_ids`.
+
+    bins_t   (F, n) int32 — transposed bin matrix (n padded to bm)
+    pos      (n,) int32   — tree-node id per sample (-1 or absent = skip)
+    g, h     (n,) f32     — weighted grad / hess per sample
+    node_ids (N,) int32   — node ids to histogram (-2 pads: match nothing)
+    """
+    F, n = bins_t.shape
+    N = node_ids.shape[0]
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu and not force_dense:
+        out = _hist_pallas(bins_t, pos, g, h, node_ids, B, bm, use_bf16)
+    else:
+        out = _hist_dense(bins_t, pos, g, h, node_ids, B, use_bf16)
+    # (F, 3N, B) -> (N, F, B, 3)
+    out = out.reshape(F, 3, N, B)
+    return jnp.transpose(out, (2, 0, 3, 1))
+
+
+def pad_inputs(bins: np.ndarray, bm: int = 8192):
+    """Host-side one-time prep: transpose + pad the bin matrix for hist_wave.
+
+    Returns (bins_t (F, n_pad) int32, n_pad). Padding rows get bin 0 but
+    are excluded by pos = -1."""
+    n, F = bins.shape
+    n_pad = _pad_to(n, bm)
+    bins_t = np.zeros((F, n_pad), np.int32)
+    bins_t[:, :n] = bins.T
+    return bins_t, n_pad

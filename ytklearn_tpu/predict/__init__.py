@@ -1,0 +1,51 @@
+"""Predictor side-stack (reference: predictor/ package, 2,907 LoC).
+
+`create_predictor(model_name, config)` mirrors
+predictor/OnlinePredictorFactory.java:32-80; `batch_predict_from_files`
+mirrors the offline CLI path (Predicts.java:36-54).
+"""
+
+from __future__ import annotations
+
+from .base import OnlinePredictor, batch_predict_from_files, parse_feature_kvs
+from .continuous import (
+    ContinuousPredictor,
+    FFMPredictor,
+    FMPredictor,
+    LinearPredictor,
+    MulticlassLinearPredictor,
+)
+from .trees import GBDTPredictor, GBSTPredictor
+
+__all__ = [
+    "OnlinePredictor",
+    "ContinuousPredictor",
+    "LinearPredictor",
+    "MulticlassLinearPredictor",
+    "FMPredictor",
+    "FFMPredictor",
+    "GBDTPredictor",
+    "GBSTPredictor",
+    "create_predictor",
+    "batch_predict_from_files",
+    "parse_feature_kvs",
+]
+
+
+def create_predictor(model_name: str, config, fs=None) -> OnlinePredictor:
+    """name -> predictor (reference: OnlinePredictorFactory.java:32-80).
+    `config` is a HOCON path or an already-parsed config dict."""
+    name = model_name.lower()
+    if name == "linear":
+        return LinearPredictor(config, fs)
+    if name == "multiclass_linear":
+        return MulticlassLinearPredictor(config, fs)
+    if name == "fm":
+        return FMPredictor(config, fs)
+    if name == "ffm":
+        return FFMPredictor(config, fs)
+    if name == "gbdt":
+        return GBDTPredictor(config, fs)
+    if name in ("gbmlr", "gbsdt", "gbhmlr", "gbhsdt"):
+        return GBSTPredictor(name, config, fs)
+    raise ValueError(f"unknown model name {model_name!r}")

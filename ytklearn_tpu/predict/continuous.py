@@ -1,0 +1,293 @@
+"""Convex-family predictors: linear, multiclass linear, FM, FFM.
+
+Rebuild of reference predictor/ContinuousOnlinePredictor.java:54 (shared
+load: transform-stat replay, feature hashing, bias) +
+LinearOnlinePredictor.java:55-165 (name->(w, std) map, Thompson sampling)
++ MulticlassLinearOnlinePredictor / FMOnlinePredictor:110-160 /
+FFMOnlinePredictor (score replay mirrored from the trainers' kernels).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..config.params import CommonParams
+from ..io.feature_hash import FeatureHash
+from ..io.fs import FileSystem
+from ..io.reader import TransformNode
+from ..losses import create_loss
+from ..models.ffm import load_field_dict
+from .base import OnlinePredictor
+
+PRECISION_MIN = 1e-9  # reference: LinearOnlinePredictor.java:38
+
+
+class ContinuousPredictor(OnlinePredictor):
+    """Shared linear-family behavior (reference:
+    ContinuousOnlinePredictor.java:54-145): typed params, loss function,
+    transform-stat sidecar replay, murmur feature hashing."""
+
+    def __init__(self, config, fs: Optional[FileSystem] = None):
+        super().__init__(config, fs)
+        self.params = CommonParams.from_config(self.config)
+        p = self.params
+        self.loss = create_loss(p.loss.loss_function)
+        fh = p.feature.feature_hash
+        self.feature_hash = (
+            FeatureHash(fh.bucket_size, fh.seed, fh.feature_prefix)
+            if fh.need_feature_hash
+            else None
+        )
+        self.transform_nodes: Dict[str, TransformNode] = {}
+        if p.feature.transform.switch_on:
+            stat_path = p.model.data_path + "_feature_transform_stat"
+            with self.fs.open(stat_path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    name, _, payload = line.partition("###")
+                    self.transform_nodes[name.strip()] = TransformNode.from_string(
+                        payload.strip()
+                    )
+        self._load_model()
+
+    # -- shared plumbing --------------------------------------------------
+
+    def _transform(self, name: str, val: float) -> float:
+        """reference: ContinuousOnlinePredictor.transform:135-143 — when
+        transform is on, features without a stat node map to 0."""
+        if not self.params.feature.transform.switch_on:
+            return val
+        node = self.transform_nodes.get(name)
+        if node is None:
+            return 0.0
+        return node.transform(val)
+
+    def _prep(self, features: Dict[str, float]) -> List[Tuple[str, float]]:
+        """bias removal + optional hashing + transform replay
+        (reference: every predictor's score() prologue)."""
+        bias_name = self.params.model.bias_feature_name
+        items = [(n, v) for n, v in features.items() if n != bias_name]
+        if self.feature_hash is not None:
+            items = self.feature_hash.hash_features(items)
+        return [(n, self._transform(n, v)) for n, v in items]
+
+    def _model_lines(self, path: str):
+        """Yield delim-split nonempty lines from every model part file."""
+        d = self.params.model.delim
+        for part in sorted(self.fs.recur_get_paths([path])):
+            with self.fs.open(part) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    info = line.split(d)
+                    if len(info) >= 2:
+                        yield info
+
+    def _load_model(self) -> None:
+        raise NotImplementedError
+
+
+class LinearPredictor(ContinuousPredictor):
+    """score = Σ w·x + bias; Thompson sampling from the Laplace precision
+    column (reference: LinearOnlinePredictor.java)."""
+
+    def __init__(self, config, fs=None, rng: Optional[np.random.RandomState] = None):
+        self.rng = rng or np.random.RandomState()
+        super().__init__(config, fs)
+
+    def _load_model(self) -> None:
+        p = self.params.model
+        if not self.fs.exists(p.data_path):
+            raise FileNotFoundError(f"linear model doesn't exist: {p.data_path}")
+        self.model_map: Dict[str, Tuple[float, float]] = {}
+        for info in self._model_lines(p.data_path):
+            name = info[0].strip()
+            wei = float(info[1])
+            if name == p.bias_feature_name:
+                std = math.sqrt(1.0 / 1e30)
+            else:
+                try:
+                    precision = max(float(info[2]), PRECISION_MIN)
+                except (IndexError, ValueError):
+                    precision = 1e30
+                std = math.sqrt(1.0 / precision)
+            self.model_map[name] = (wei, std)
+
+    def score(self, features, other=None) -> float:
+        p = self.params.model
+        s = 0.0
+        for name, val in self._prep(features):
+            param = self.model_map.get(name)
+            if param is not None:
+                s += param[0] * val
+        if p.need_bias:
+            param = self.model_map.get(p.bias_feature_name)
+            if param is not None:
+                s += param[0]
+        return s
+
+    def thompson_sampling_predict(self, features, alpha: float) -> float:
+        """Exploration via the Laplace posterior: w ~ N(w, alpha²·std²)
+        (reference: LinearOnlinePredictor.thompsonSamplingPredict:141-163)."""
+        p = self.params.model
+        s = 0.0
+        for name, val in self._prep(features):
+            param = self.model_map.get(name)
+            if param is not None:
+                w, std = param
+                s += (w + self.rng.randn() * alpha * std) * val
+        if p.need_bias:
+            param = self.model_map.get(p.bias_feature_name)
+            if param is not None:
+                s += param[0]
+        return float(self.loss.predict(s))
+
+
+class MulticlassLinearPredictor(ContinuousPredictor):
+    """K−1 scores + implicit 0 (reference: MulticlassLinearOnlinePredictor;
+    model lines `name,w_0,...,w_{K-2}`)."""
+
+    def _load_model(self) -> None:
+        p = self.params.model
+        self.K = int(self.params.k)
+        self.n_outputs = self.K
+        if not self.fs.exists(p.data_path):
+            raise FileNotFoundError(f"model doesn't exist: {p.data_path}")
+        self.model_map: Dict[str, np.ndarray] = {}
+        for info in self._model_lines(p.data_path):
+            self.model_map[info[0].strip()] = np.asarray(
+                [float(v) for v in info[1 : self.K]], np.float64
+            )
+
+    def scores(self, features, other=None) -> List[float]:
+        p = self.params.model
+        s = np.zeros(self.K - 1, np.float64)
+        for name, val in self._prep(features):
+            w = self.model_map.get(name)
+            if w is not None:
+                s += w * val
+        if p.need_bias:
+            w = self.model_map.get(p.bias_feature_name)
+            if w is not None:
+                s += w
+        return list(s) + [0.0]
+
+    def score(self, features, other=None) -> float:
+        raise ValueError("multiclass_linear is multi-output; use scores()")
+
+    def predicts(self, features, other=None) -> List[float]:
+        return [float(v) for v in self.loss.predict(np.asarray(self.scores(features)))]
+
+    def predict(self, features, other=None) -> float:
+        raise ValueError("multiclass_linear is multi-output; use predicts()")
+
+    def loss_value(self, features, label, other=None) -> float:
+        s = np.asarray(self.scores(features, other))
+        return float(self.loss.loss(s, np.asarray(label)))
+
+
+class FMPredictor(ContinuousPredictor):
+    """wx + ½Σ_k[(Σ v x)² − Σ (v x)²]; the bias (when configured) adds its
+    weight and latent row with x = 1 (reference: FMOnlinePredictor.java:110-160)."""
+
+    def _load_model(self) -> None:
+        p = self.params.model
+        k = self.params.k
+        self.sok = int(k[1])
+        self.need_first_order = int(k[0]) >= 1
+        if not self.fs.exists(p.data_path):
+            raise FileNotFoundError(f"model doesn't exist: {p.data_path}")
+        self.model_map: Dict[str, np.ndarray] = {}
+        for info in self._model_lines(p.data_path):
+            self.model_map[info[0].strip()] = np.asarray(
+                [float(v) for v in info[1 : 2 + self.sok]], np.float64
+            )
+
+    def score(self, features, other=None) -> float:
+        p = self.params.model
+        wx = 0.0
+        S = np.zeros(self.sok, np.float64)
+        S2 = np.zeros(self.sok, np.float64)
+        w = self.model_map.get(p.bias_feature_name)
+        if w is not None and p.need_bias:
+            wx += w[0]
+            v = w[1:]
+            S += v
+            S2 += v * v
+        for name, val in self._prep(features):
+            w = self.model_map.get(name)
+            if w is None:
+                continue
+            if self.need_first_order:
+                wx += w[0] * val
+            v = w[1:] * val
+            S += v
+            S2 += v * v
+        return wx + 0.5 * float(np.sum(S * S - S2))
+
+
+class FFMPredictor(ContinuousPredictor):
+    """Field-aware pairwise terms: Σ_{p<q} v_p[f_q]·v_q[f_p] x_p x_q
+    (reference: FFMOnlinePredictor; model lines
+    `name,w,v[field0 k..],v[field1 k..],...`)."""
+
+    def _load_model(self) -> None:
+        p = self.params.model
+        k = self.params.k
+        self.sok = int(k[1])
+        self.need_first_order = int(k[0]) >= 1
+        if not p.field_dict_path:
+            raise ValueError("ffm requires model.field_dict_path")
+        self.field_map = load_field_dict(self.fs, p.field_dict_path)
+        self.n_fields = len(self.field_map)
+        if not self.fs.exists(p.data_path):
+            raise FileNotFoundError(f"model doesn't exist: {p.data_path}")
+        self.model_map: Dict[str, np.ndarray] = {}
+        stride = self.n_fields * self.sok
+        for info in self._model_lines(p.data_path):
+            self.model_map[info[0].strip()] = np.asarray(
+                [float(v) for v in info[1 : 2 + stride]], np.float64
+            )
+
+    def _field_of(self, name: str) -> int:
+        """Field from the feature name prefix before field_delim
+        (mirrors DataIngest.to_dataset: unknown field -> feature dropped)."""
+        fd = self.params.data.delim.field_delim
+        return self.field_map.get(name.split(fd)[0], -1)
+
+    def score(self, features, other=None) -> float:
+        p = self.params.model
+        wx = 0.0
+        rows = []  # (field, val, V (n_fields, k))
+        w = self.model_map.get(p.bias_feature_name)
+        if w is not None and p.need_bias:
+            # bias rides as a (field 0, x=1) entry like the trainer ingest
+            # (reader.to_dataset:466); its latent row is zero unless
+            # bias_need_latent_factor was on at train time
+            wx += w[0]
+            if self.sok > 0:
+                rows.append((0, 1.0, w[1:].reshape(self.n_fields, self.sok)))
+        for name, val in self._prep(features):
+            w = self.model_map.get(name)
+            if w is None:
+                continue
+            fld = self._field_of(name)
+            if fld < 0:
+                continue  # unknown field: dropped entirely, like training
+            if self.need_first_order:
+                wx += w[0] * val
+            if self.sok > 0:
+                rows.append((fld, val, w[1:].reshape(self.n_fields, self.sok)))
+        s = wx
+        for i in range(len(rows)):
+            fi, xi, Vi = rows[i]
+            for j in range(i + 1, len(rows)):
+                fj, xj, Vj = rows[j]
+                s += float(np.dot(Vi[fj], Vj[fi])) * xi * xj
+        return s
