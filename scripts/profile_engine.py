@@ -1,0 +1,79 @@
+"""Profile + quality-check the device GBDT engine on the real chip.
+
+Usage: python scripts/profile_engine.py [n_rows] [n_trees] [wave] [policy] [leaves]
+Prints per-tree timing and final train/test quality.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+
+def main() -> None:
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+    n_trees = int(sys.argv[2]) if len(sys.argv) > 2 else 5
+    wave = int(sys.argv[3]) if len(sys.argv) > 3 else 16
+    policy = sys.argv[4] if len(sys.argv) > 4 else "loss"
+    leaves = int(sys.argv[5]) if len(sys.argv) > 5 else 255
+
+    from ytklearn_tpu.config.params import ApproximateSpec, GBDTParams, ModelParams
+    from ytklearn_tpu.gbdt.data import GBDTData
+    from ytklearn_tpu.gbdt.trainer import GBDTTrainer
+
+    F = 28
+    rng = np.random.RandomState(0)
+
+    def mk(n, seed):
+        r = np.random.RandomState(seed)
+        X = r.randn(n, F).astype(np.float32)
+        logit = (
+            1.5 * X[:, 0] * X[:, 1]
+            + np.sin(X[:, 2] * 2)
+            + 0.8 * (X[:, 3] > 0.5)
+            - 0.5 * X[:, 4] ** 2
+            + 0.3 * X[:, 5] * X[:, 6]
+        )
+        y = (logit + r.randn(n) * 0.5 > 0).astype(np.float32)
+        return GBDTData(
+            X=X, y=y, weight=np.ones(n, np.float32), n_real=n,
+            feature_names=[f"f{i}" for i in range(F)],
+        )
+
+    train = mk(n, 0)
+    test = mk(max(n // 10, 10000), 1)
+
+    params = GBDTParams(
+        round_num=n_trees,
+        max_depth=60 if policy == "loss" else 8,
+        max_leaf_cnt=leaves,
+        tree_grow_policy=policy,
+        learning_rate=0.1,
+        min_child_hessian_sum=100.0,
+        loss_function="sigmoid",
+        eval_metric=["auc"],
+        approximate=[ApproximateSpec(max_cnt=255)],
+        model=ModelParams(data_path="/tmp/profile_engine_model", dump_freq=0),
+    )
+    t0 = time.time()
+    trainer = GBDTTrainer(params, engine="device", wave=wave)
+    res = trainer.train(train=train, test=test)
+    dt = time.time() - t0
+    nb = len(res.model.trees)
+    print(
+        f"policy={policy} wave={wave} rows={n} trees={nb} total={dt:.1f}s "
+        f"trees/s={nb/dt:.3f} train_loss={res.train_loss:.5f} "
+        f"test_loss={res.test_loss:.5f} test_auc={res.test_metrics.get('auc'):.5f}"
+    )
+    sizes = [t.n_nodes() for t in res.model.trees]
+    depths = [t.max_depth() for t in res.model.trees]
+    print(f"tree nodes min/med/max: {min(sizes)}/{sorted(sizes)[len(sizes)//2]}/{max(sizes)}"
+          f"  depth max: {max(depths)}")
+
+
+if __name__ == "__main__":
+    main()

@@ -68,7 +68,8 @@ def main() -> None:
         f"trees/s={n_built / dt:.3f} train_loss={res.train_loss:.5f}"
     )
     for rec in res.round_log:
-        print(f"  round {rec['round']}: cum {rec['elapsed']:.1f}s")
+        if "elapsed" in rec:
+            print(f"  round {rec['round']}: cum {rec['elapsed']:.1f}s")
 
 
 if __name__ == "__main__":

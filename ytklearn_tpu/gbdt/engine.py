@@ -1,0 +1,409 @@
+"""Device-resident GBDT tree growth — the whole tree as ONE XLA program.
+
+Rebuild of reference optimizer/gbdt/DataParallelTreeMaker.java:229-653
+(expand queue, histogram build + reduce-scatter, sibling subtraction via
+HistogramPool, split enumeration, sample position update) re-architected
+for the TPU's cost model: device->host transfers through this machine's
+tunnel cost ~115 ms EACH, so the reference's host-driven expand loop
+(host pops a queue node, launches a histogram, reads back split stats)
+would spend seconds per tree in latency alone. Instead the full growth
+loop runs on device inside lax.while_loop; the host enqueues one program
+per tree and reads nothing back until training ends.
+
+Growth is organized in WAVES of up to `spec.wave` node expansions:
+  1. select expandable frontier nodes — by (depth, node id) for the level
+     policy (exactly the reference's level order, including the leaf-
+     budget count-off), by descending best-gain for the loss policy
+     (wave=1 is exactly the reference's best-first; wave=T>1 relaxes the
+     pop granularity to T for throughput — T gain-ordered splits per
+     histogram pass instead of one)
+  2. record the splits into fixed-size tree arrays, allocate children
+  3. route samples: per wave node, one bins_t row slice + compare
+     (SamplePositionData.resetPosition:115 without the re-sort)
+  4. histogram the SMALLER child of each split via the Pallas one-hot
+     matmul kernel; derive the sibling by pool subtraction
+     (HistogramPool's trick, data/gbdt/HistogramPool.java)
+  5. enumerate best splits for all new children (split_kernel) and
+     refresh the frontier arrays.
+
+All arrays are fixed-shape: tree fields are (max_nodes,), the histogram
+pool is (max_nodes, F, B, 3), the wave is padded to `spec.wave` with
+masked no-op slots (scatter mode="drop").
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .hist import hist_wave
+
+BIG32 = np.int32(2**31 - 1)
+
+
+# ---------------------------------------------------------------------------
+# Gain / leaf-value formulas (reference: UpdateStrategy.java:64-83)
+# ---------------------------------------------------------------------------
+
+
+def _threshold_l1(g, l1):
+    return jnp.where(g > l1, g - l1, jnp.where(g < -l1, g + l1, 0.0))
+
+
+def make_gain_fns(l1: float, l2: float, min_h: float, max_abs: float):
+    def node_value(G, H):
+        t = _threshold_l1(G, l1) if l1 > 0 else G
+        val = -t / (H + l2)
+        if max_abs > 0:
+            val = jnp.clip(val, -max_abs, max_abs)
+        return jnp.where(H < min_h, 0.0, val)
+
+    def gain(G, H):
+        if max_abs <= 0:
+            t = _threshold_l1(G, l1) if l1 > 0 else G
+            out = t * t / (H + l2)
+        else:
+            v = node_value(G, H)
+            out = -2.0 * (G * v + 0.5 * (H + l2) * v * v + l1 * jnp.abs(v))
+        return jnp.where(H < min_h, 0.0, out)
+
+    return gain, node_value
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def split_kernel(hist, feat_mask, cfg):
+    """Best split per node from (N, F, B, 3) histograms.
+
+    Returns per-node: (loss_chg, flat_idx, slot_left, GL, HL, CL, GR, HR, CR)
+    (reference: DataParallelTreeMaker.enumerateSplit:598-637 — empty slots
+    skipped, split interval [last nonempty, current], child-hessian guards,
+    gain vs root; first-max argmax reproduces SplitInfo.needReplace:99's
+    lower-slot tie-break)."""
+    l1, l2, min_h, max_abs = cfg
+    N, F, B, _ = hist.shape
+    G, H, C = hist[..., 0], hist[..., 1], hist[..., 2]
+    gain, _ = make_gain_fns(l1, l2, min_h, max_abs)
+
+    # exclusive cumsums: stats strictly left of boundary slot j
+    GL = jnp.cumsum(G, axis=-1) - G
+    HL = jnp.cumsum(H, axis=-1) - H
+    CL = jnp.cumsum(C, axis=-1) - C
+    Gt = jnp.sum(G, axis=-1, keepdims=True)
+    Ht = jnp.sum(H, axis=-1, keepdims=True)
+    Ct = jnp.sum(C, axis=-1, keepdims=True)
+    GR, HR, CR = Gt - GL, Ht - HL, Ct - CL
+
+    nonempty = C > 0
+    has_prev = (jnp.cumsum(nonempty.astype(jnp.int32), axis=-1) - nonempty) > 0
+    valid = nonempty & has_prev & (HL >= min_h) & (HR >= min_h)
+    valid = valid & feat_mask[None, :, None]
+
+    # node totals: every active sample hits every feature's histogram, so
+    # feature 0's bin-sum is the node total
+    root_gain = gain(Gt[:, 0:1, 0], Ht[:, 0:1, 0])
+
+    loss_chg = gain(GL, HL) + gain(GR, HR) - root_gain[:, :, None]
+    loss_chg = jnp.where(valid, loss_chg, -jnp.inf)
+
+    flat = loss_chg.reshape(N, F * B)
+    best = jnp.argmax(flat, axis=-1)  # first max -> lowest (f, slot) tie-break
+    best_chg = jnp.take_along_axis(flat, best[:, None], axis=-1)[:, 0]
+
+    # last nonempty slot strictly before j (the split interval's left end)
+    idxs = jnp.where(nonempty, jnp.arange(B)[None, None, :], -1)
+    lastne_incl = jax.lax.cummax(idxs, axis=2)
+    lastne = jnp.concatenate(
+        [jnp.full((N, F, 1), -1, lastne_incl.dtype), lastne_incl[:, :, :-1]], axis=2
+    ).reshape(N, F * B)
+    slot_left = jnp.take_along_axis(lastne, best[:, None], axis=-1)[:, 0]
+
+    def pick(A):
+        return jnp.take_along_axis(A.reshape(N, F * B), best[:, None], axis=-1)[:, 0]
+
+    return (
+        best_chg,
+        best.astype(jnp.int32),
+        slot_left.astype(jnp.int32),
+        pick(GL),
+        pick(HL),
+        pick(CL),
+        pick(GR),
+        pick(HR),
+        pick(CR),
+    )
+
+
+# ---------------------------------------------------------------------------
+# The growth engine
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class GrowSpec:
+    """Static shape/config for one tree-growth program."""
+
+    F: int
+    B: int
+    max_nodes: int  # tree array capacity (2*max_leaves-1 or full level tree)
+    wave: int  # node expansions per wave (loss policy: best-first pop width)
+    policy: str  # "level" | "loss"
+    max_depth: int  # <=0 = unlimited
+    max_leaves: int  # <=0 = unlimited
+    lr: float
+    l1: float
+    l2: float
+    min_h: float
+    max_abs: float
+    min_split_loss: float
+    min_split_samples: float
+    bm: int = 8192
+    use_bf16: bool = True
+    force_dense: bool = False
+
+    @property
+    def depth_cap(self) -> int:
+        return self.max_depth if self.max_depth > 0 else self.max_nodes
+
+    @property
+    def leaf_cap(self) -> int:
+        # unlimited -> whatever fits the fixed arrays (nodes = 2*leaves-1)
+        return self.max_leaves if self.max_leaves > 0 else (self.max_nodes + 1) // 2
+
+
+class TreeArrays(NamedTuple):
+    """Fixed-shape device tree (mirrors the host Tree fields that training
+    needs; converted to gbdt.tree.Tree after the final fetch)."""
+
+    feat: jnp.ndarray  # (M,) i32, -1 = leaf
+    slot: jnp.ndarray  # (M,) i32 routing threshold (last nonempty before split)
+    slot_r: jnp.ndarray  # (M,) i32 split interval right end (value conversion)
+    left: jnp.ndarray  # (M,) i32
+    right: jnp.ndarray  # (M,) i32
+    leaf: jnp.ndarray  # (M,) f32 (lr-scaled)
+    gain: jnp.ndarray  # (M,) f32
+    hess: jnp.ndarray  # (M,) f32
+    cnt: jnp.ndarray  # (M,) f32
+    depth: jnp.ndarray  # (M,) i32
+    n_nodes: jnp.ndarray  # () i32
+
+
+class _Frontier(NamedTuple):
+    chg: jnp.ndarray  # (M,) f32, -inf = none
+    flat: jnp.ndarray  # (M,) i32 best f*B+slot
+    slotl: jnp.ndarray  # (M,) i32
+    GL: jnp.ndarray
+    HL: jnp.ndarray
+    CL: jnp.ndarray
+    GR: jnp.ndarray
+    HR: jnp.ndarray
+    CR: jnp.ndarray
+    active: jnp.ndarray  # (M,) bool
+
+
+def _route_wave(bins_t, pos, sel_valid, sel_nid, sel_feat, sel_slot, sel_l, sel_r, NW):
+    """Move samples of each wave node to its children: one bins_t row
+    dynamic-slice + compare per wave slot (masked no-op when invalid)."""
+    n = pos.shape[0]
+
+    def body(i, pos):
+        f = jnp.maximum(sel_feat[i], 0)
+        row = jax.lax.dynamic_slice(bins_t, (f, jnp.zeros((), f.dtype)), (1, n))[0]
+        go_right = row > sel_slot[i]
+        child = jnp.where(go_right, sel_r[i], sel_l[i])
+        upd = jnp.where(pos == sel_nid[i], child, pos)
+        return jnp.where(sel_valid[i], upd, pos)
+
+    return jax.lax.fori_loop(0, NW, body, pos)
+
+
+def make_grow_tree(spec: GrowSpec):
+    """Build the jitted grow(bins_t, include, g, h, feat_mask[, aux]) fn.
+
+    aux: optional (bins_t_extra, ...) tuple of extra transposed bin
+    matrices (e.g. the test set) whose row positions are routed through
+    the same splits; their final leaf assignment comes back alongside.
+
+    Returns (TreeArrays, pos_final, aux_pos_final).
+    """
+    M, NW, F, B = spec.max_nodes, spec.wave, spec.F, spec.B
+    cfg = (spec.l1, spec.l2, spec.min_h, spec.max_abs)
+    _, node_value = make_gain_fns(*cfg)
+    iota_m = jnp.arange(M, dtype=jnp.int32)
+
+    def can_split(fr: _Frontier, tr: TreeArrays, leaves):
+        ok = fr.active & jnp.isfinite(fr.chg) & (fr.chg > spec.min_split_loss)
+        ok &= (fr.CL + fr.CR) >= spec.min_split_samples
+        ok &= (fr.HL + fr.HR) >= 2.0 * spec.min_h
+        ok &= tr.depth < spec.depth_cap
+        # capacity: children must fit the fixed arrays
+        return ok & (leaves < spec.leaf_cap)
+
+    def select(ok, fr: _Frontier, tr: TreeArrays):
+        if spec.policy == "level":
+            k1 = jnp.where(ok, tr.depth, BIG32)
+            _, sel = jax.lax.sort((k1, iota_m), num_keys=2)
+        else:
+            k1 = jnp.where(ok, -fr.chg, jnp.inf)
+            _, sel = jax.lax.sort((k1, iota_m), num_keys=2)
+        sel = sel[:NW]
+        return sel, ok[sel]
+
+    def grow(bins_t, include, g, h, feat_mask, aux=()):
+        n = bins_t.shape[1]
+        pos = jnp.zeros((n,), jnp.int32)
+        aux_pos = tuple(jnp.zeros((bt.shape[1],), jnp.int32) for bt in aux)
+
+        tr = TreeArrays(
+            feat=jnp.full((M,), -1, jnp.int32),
+            slot=jnp.zeros((M,), jnp.int32),
+            slot_r=jnp.zeros((M,), jnp.int32),
+            left=jnp.full((M,), -1, jnp.int32),
+            right=jnp.full((M,), -1, jnp.int32),
+            leaf=jnp.zeros((M,), jnp.float32),
+            gain=jnp.zeros((M,), jnp.float32),
+            hess=jnp.zeros((M,), jnp.float32),
+            cnt=jnp.zeros((M,), jnp.float32),
+            depth=jnp.zeros((M,), jnp.int32),
+            n_nodes=jnp.asarray(1, jnp.int32),
+        )
+
+        # root histogram + stats + frontier
+        ids0 = jnp.full((NW,), -2, jnp.int32).at[0].set(0)
+        pos_fit = jnp.where(include, pos, -1)
+        hist0 = hist_wave(
+            bins_t, pos_fit, g, h, ids0, B,
+            bm=spec.bm, use_bf16=spec.use_bf16, force_dense=spec.force_dense,
+        )  # (NW, F, B, 3)
+        root_ghc = jnp.sum(hist0[0, 0], axis=0)  # feature 0 bin-sum = totals
+        tr = tr._replace(
+            hess=tr.hess.at[0].set(root_ghc[1]),
+            cnt=tr.cnt.at[0].set(root_ghc[2]),
+            leaf=tr.leaf.at[0].set(node_value(root_ghc[0], root_ghc[1]) * spec.lr),
+        )
+        pool = jnp.zeros((M, F, B, 3), jnp.float32)
+        pool = pool.at[0].set(hist0[0])
+
+        out0 = split_kernel(hist0[:1], feat_mask, cfg)
+        f32 = jnp.float32
+        fr = _Frontier(
+            chg=jnp.full((M,), -jnp.inf, f32).at[0].set(out0[0][0]),
+            flat=jnp.zeros((M,), jnp.int32).at[0].set(out0[1][0]),
+            slotl=jnp.zeros((M,), jnp.int32).at[0].set(out0[2][0]),
+            GL=jnp.zeros((M,), f32).at[0].set(out0[3][0]),
+            HL=jnp.zeros((M,), f32).at[0].set(out0[4][0]),
+            CL=jnp.zeros((M,), f32).at[0].set(out0[5][0]),
+            GR=jnp.zeros((M,), f32).at[0].set(out0[6][0]),
+            HR=jnp.zeros((M,), f32).at[0].set(out0[7][0]),
+            CR=jnp.zeros((M,), f32).at[0].set(out0[8][0]),
+            active=jnp.zeros((M,), bool).at[0].set(True),
+        )
+        leaves0 = jnp.asarray(1, jnp.int32)
+
+        def cond(state):
+            tr, fr, pool, pos, aux_pos, leaves = state
+            return jnp.any(can_split(fr, tr, leaves))
+
+        def body(state):
+            tr, fr, pool, pos, aux_pos, leaves = state
+            ok = can_split(fr, tr, leaves)
+            sel, sel_ok = select(ok, fr, tr)
+
+            # leaf budget count-off in selection order (level: node order
+            # within the level; loss: gain order) — reference semantics
+            order_cum = jnp.cumsum(sel_ok.astype(jnp.int32), dtype=jnp.int32)
+            sel_ok &= (leaves + order_cum) <= spec.leaf_cap
+            k_cnt = jnp.sum(sel_ok, dtype=jnp.int32)
+
+            # children allocation in selection order
+            prefix = jnp.cumsum(
+                sel_ok.astype(jnp.int32), dtype=jnp.int32
+            ) - sel_ok.astype(jnp.int32)
+            lch = tr.n_nodes + 2 * prefix
+            rch = lch + 1
+            nid = sel
+            scatter_id = jnp.where(sel_ok, nid, M)  # M = dropped
+            lch_id = jnp.where(sel_ok, lch, M)
+            rch_id = jnp.where(sel_ok, rch, M)
+
+            f_best = fr.flat[nid] // B
+            slot_r = fr.flat[nid] % B
+            slot_l = fr.slotl[nid]
+            GLs, HLs, CLs = fr.GL[nid], fr.HL[nid], fr.CL[nid]
+            GRs, HRs, CRs = fr.GR[nid], fr.HR[nid], fr.CR[nid]
+            child_depth = tr.depth[nid] + 1
+
+            drop = dict(mode="drop")
+            tr = tr._replace(
+                feat=tr.feat.at[scatter_id].set(f_best, **drop),
+                slot=tr.slot.at[scatter_id].set(slot_l, **drop),
+                slot_r=tr.slot_r.at[scatter_id].set(slot_r, **drop),
+                left=tr.left.at[scatter_id].set(lch, **drop),
+                right=tr.right.at[scatter_id].set(rch, **drop),
+                gain=tr.gain.at[scatter_id].set(fr.chg[nid], **drop),
+                leaf=tr.leaf.at[lch_id]
+                .set(node_value(GLs, HLs) * spec.lr, **drop)
+                .at[rch_id]
+                .set(node_value(GRs, HRs) * spec.lr, **drop),
+                hess=tr.hess.at[lch_id].set(HLs, **drop).at[rch_id].set(HRs, **drop),
+                cnt=tr.cnt.at[lch_id].set(CLs, **drop).at[rch_id].set(CRs, **drop),
+                depth=tr.depth.at[lch_id]
+                .set(child_depth, **drop)
+                .at[rch_id]
+                .set(child_depth, **drop),
+                n_nodes=(tr.n_nodes + 2 * k_cnt).astype(jnp.int32),
+            )
+
+            # routing (train + any aux sets)
+            pos = _route_wave(bins_t, pos, sel_ok, nid, f_best, slot_l, lch, rch, NW)
+            aux_pos = tuple(
+                _route_wave(bt, ap, sel_ok, nid, f_best, slot_l, lch, rch, NW)
+                for bt, ap in zip(aux, aux_pos)
+            )
+
+            # smaller-child histogram + sibling subtraction
+            small = jnp.where(CLs <= CRs, lch, rch)
+            big = jnp.where(CLs <= CRs, rch, lch)
+            ids = jnp.where(sel_ok, small, -2)
+            pos_fit = jnp.where(include, pos, -1)
+            h_small = hist_wave(
+                bins_t, pos_fit, g, h, ids, B,
+                bm=spec.bm, use_bf16=spec.use_bf16, force_dense=spec.force_dense,
+            )
+            parent_h = pool[nid]
+            h_big = parent_h - h_small
+            pool = pool.at[jnp.where(sel_ok, small, M)].set(h_small, **drop)
+            pool = pool.at[jnp.where(sel_ok, big, M)].set(h_big, **drop)
+
+            # frontier refresh for the 2*NW children
+            child_ids = jnp.concatenate([small, big])
+            child_ok = jnp.concatenate([sel_ok, sel_ok])
+            hists = jnp.concatenate([h_small, h_big], axis=0)
+            out = split_kernel(hists, feat_mask, cfg)
+            cids = jnp.where(child_ok, child_ids, M)
+            fr = _Frontier(
+                chg=fr.chg.at[scatter_id].set(-jnp.inf, **drop).at[cids].set(out[0], **drop),
+                flat=fr.flat.at[cids].set(out[1], **drop),
+                slotl=fr.slotl.at[cids].set(out[2], **drop),
+                GL=fr.GL.at[cids].set(out[3], **drop),
+                HL=fr.HL.at[cids].set(out[4], **drop),
+                CL=fr.CL.at[cids].set(out[5], **drop),
+                GR=fr.GR.at[cids].set(out[6], **drop),
+                HR=fr.HR.at[cids].set(out[7], **drop),
+                CR=fr.CR.at[cids].set(out[8], **drop),
+                active=fr.active.at[scatter_id]
+                .set(False, **drop)
+                .at[cids]
+                .set(True, **drop),
+            )
+            return (tr, fr, pool, pos, aux_pos, (leaves + k_cnt).astype(jnp.int32))
+
+        state = (tr, fr, pool, pos, aux_pos, leaves0)
+        tr, fr, pool, pos, aux_pos, leaves = jax.lax.while_loop(cond, body, state)
+        return tr, pos, aux_pos
+
+    return grow

@@ -6,21 +6,27 @@ build, split enumeration, position update) + UpdateStrategy.java:64-83
 (gain / leaf-value formulas incl. L1 soft-threshold + leaf clamp) +
 TreeRefiner.java (LAD weighted-median leaves).
 
-TPU-first design:
-  - the bin matrix (n, F) int32 lives on device, rows sharded over the mesh
-  - histograms are one fused segment-sum per level (channels g/h/count);
-    under jit with sharded rows XLA reduces partial histograms with a psum
-    — the reduce-scatter of HistogramBuilder.java:95 without hand-rolling
+Two growth engines share the split/gain kernels (gbdt/engine.py):
+
+  device (default) — the whole tree grows inside one XLA program
+    (engine.make_grow_tree): Pallas one-hot-matmul histograms, on-device
+    frontier selection, sibling subtraction in a device histogram pool,
+    and per-round score/loss updates — zero host round-trips per round.
+    Built for this machine's cost model (D2H ~115 ms per transfer).
+  host — the original per-level/per-split host loop. Kept as the
+    reference implementation for equivalence tests, and used
+    automatically for l1 loss (LAD leaf refinement is a host-side
+    weighted median, reference TreeRefiner.java:72-123).
+
+TPU-first design notes:
+  - the bin matrix lives transposed (F, n) so routing is a row
+    dynamic-slice + lane compare, and the Pallas kernel reads lane-major
+  - histograms are one fused MXU pass per wave; with rows sharded over a
+    mesh XLA psums the partial histograms (the reduceScatterArray of
+    HistogramBuilder.java:95 without hand-rolling)
   - split enumeration is a cumulative-sum scan over all (node, feature,
-    bin) at once; the global best per node is an argmax whose first-max
-    semantics reproduce SplitInfo.needReplace's lower-slot tie-break
-  - empty bins are skipped exactly like the reference: the split interval
-    is [last nonempty slot, current slot] and the dumped split value is
-    their mean/median (FeatureSplitType)
-  - level-wise growth runs one device program per level; loss-wise growth
-    keeps per-frontier-node histograms and computes each smaller child by
-    a masked scan, deriving the sibling by subtraction (the HistogramPool
-    trick, data/gbdt/HistogramPool.java)
+    bin) at once; first-max argmax reproduces SplitInfo.needReplace's
+    lower-slot tie-break
 """
 
 from __future__ import annotations
@@ -43,46 +49,15 @@ from ..losses import create_loss
 from ..parallel.mesh import row_sharding
 from .binning import FeatureBins, bin_matrix, build_bins
 from .data import GBDTData, GBDTIngest
+from .engine import GrowSpec, make_gain_fns, make_grow_tree, split_kernel
+from .hist import pad_inputs
 from .tree import GBDTModel, Tree
 
 log = logging.getLogger("ytklearn_tpu.gbdt")
 
 
 # ---------------------------------------------------------------------------
-# Gain / leaf-value formulas (reference: UpdateStrategy.java)
-# ---------------------------------------------------------------------------
-
-
-def _threshold_l1(g, l1):
-    return jnp.where(g > l1, g - l1, jnp.where(g < -l1, g + l1, 0.0))
-
-
-def make_gain_fns(params: GBDTParams):
-    l1, l2 = params.l1, params.l2
-    min_h = params.min_child_hessian_sum
-    max_abs = params.max_abs_leaf_val
-
-    def node_value(G, H):
-        t = _threshold_l1(G, l1) if l1 > 0 else G
-        val = -t / (H + l2)
-        if max_abs > 0:
-            val = jnp.clip(val, -max_abs, max_abs)
-        return jnp.where(H < min_h, 0.0, val)
-
-    def gain(G, H):
-        if max_abs <= 0:
-            t = _threshold_l1(G, l1) if l1 > 0 else G
-            out = t * t / (H + l2)
-        else:
-            v = node_value(G, H)
-            out = -2.0 * (G * v + 0.5 * (H + l2) * v * v + l1 * jnp.abs(v))
-        return jnp.where(H < min_h, 0.0, out)
-
-    return gain, node_value
-
-
-# ---------------------------------------------------------------------------
-# Device kernels (data passed as args — no captured constants)
+# Host-path device kernels (the original level/loss-wise implementation)
 # ---------------------------------------------------------------------------
 
 
@@ -90,10 +65,8 @@ def make_gain_fns(params: GBDTParams):
 def hist_kernel(bins, pos, g, h, n_nodes: int, F: int, B: int):
     """(n_nodes, F, B, 3) histogram of (g, h, count) by level-local node.
 
-    pos < 0 = inactive sample -> dump segment. One fused scatter-add — the
-    hottest loop of the reference (HistogramBuilder.java:72-90) as a single
-    XLA op; with rows sharded, XLA psums the partial histograms
-    (the reduceScatterArray at :95)."""
+    pos < 0 = inactive sample -> dump segment. Scatter-add formulation —
+    fine on CPU, slow on TPU (the device engine uses gbdt/hist.py)."""
     n = bins.shape[0]
     active = pos >= 0
     base = jnp.where(active, pos, n_nodes) * (F * B)
@@ -106,82 +79,6 @@ def hist_kernel(bins, pos, g, h, n_nodes: int, F: int, B: int):
         jnp.repeat(vals, F, axis=0).reshape(n, F, 3).reshape(-1, 3)
     )
     return flat[: n_nodes * F * B].reshape(n_nodes, F, B, 3)
-
-
-@partial(jax.jit, static_argnames=("cfg",))
-def split_kernel(hist, feat_mask, cfg):
-    """Best split per node from (N, F, B, 3) histograms.
-
-    Returns per-node: (loss_chg, flat_idx, slot_left, GL, HL, CL, GR, HR, CR)
-    (reference: enumerateSplit:598-637 — empty slots skipped, split interval
-    [last nonempty, current], child-hessian guards, gain vs root)."""
-    l1, l2, min_h, max_abs = cfg
-    N, F, B, _ = hist.shape
-    G, H, C = hist[..., 0], hist[..., 1], hist[..., 2]
-
-    def node_value(Gv, Hv):
-        t = _threshold_l1(Gv, l1) if l1 > 0 else Gv
-        val = -t / (Hv + l2)
-        if max_abs > 0:
-            val = jnp.clip(val, -max_abs, max_abs)
-        return jnp.where(Hv < min_h, 0.0, val)
-
-    def gain(Gv, Hv):
-        if max_abs <= 0:
-            t = _threshold_l1(Gv, l1) if l1 > 0 else Gv
-            out = t * t / (Hv + l2)
-        else:
-            v = node_value(Gv, Hv)
-            out = -2.0 * (Gv * v + 0.5 * (Hv + l2) * v * v + l1 * jnp.abs(v))
-        return jnp.where(Hv < min_h, 0.0, out)
-
-    # exclusive cumsums: stats strictly left of boundary slot j
-    GL = jnp.cumsum(G, axis=-1) - G
-    HL = jnp.cumsum(H, axis=-1) - H
-    CL = jnp.cumsum(C, axis=-1) - C
-    Gt = jnp.sum(G, axis=-1, keepdims=True)
-    Ht = jnp.sum(H, axis=-1, keepdims=True)
-    Ct = jnp.sum(C, axis=-1, keepdims=True)
-    GR, HR, CR = Gt - GL, Ht - HL, Ct - CL
-
-    nonempty = C > 0
-    has_prev = (jnp.cumsum(nonempty.astype(jnp.int32), axis=-1) - nonempty) > 0
-    valid = nonempty & has_prev & (HL >= min_h) & (HR >= min_h)
-    valid = valid & feat_mask[None, :, None]
-
-    # node totals: every active sample hits every feature's histogram, so
-    # feature 0's bin-sum is the node total
-    root_gain = gain(jnp.sum(G, axis=-1)[:, 0:1], jnp.sum(H, axis=-1)[:, 0:1])
-
-    loss_chg = gain(GL, HL) + gain(GR, HR) - root_gain[:, :, None]
-    loss_chg = jnp.where(valid, loss_chg, -jnp.inf)
-
-    flat = loss_chg.reshape(N, F * B)
-    best = jnp.argmax(flat, axis=-1)  # first max -> lowest (f, slot): tie-break
-    best_chg = jnp.take_along_axis(flat, best[:, None], axis=-1)[:, 0]
-
-    # last nonempty slot strictly before j (the split interval's left end)
-    idxs = jnp.where(nonempty, jnp.arange(B)[None, None, :], -1)
-    lastne_incl = jax.lax.cummax(idxs, axis=2)
-    lastne = jnp.concatenate(
-        [jnp.full((N, F, 1), -1, lastne_incl.dtype), lastne_incl[:, :, :-1]], axis=2
-    ).reshape(N, F * B)
-    slot_left = jnp.take_along_axis(lastne, best[:, None], axis=-1)[:, 0]
-
-    def pick(A):
-        return jnp.take_along_axis(A.reshape(N, F * B), best[:, None], axis=-1)[:, 0]
-
-    return (
-        best_chg,
-        best.astype(jnp.int32),
-        slot_left.astype(jnp.int32),
-        pick(GL),
-        pick(HL),
-        pick(CL),
-        pick(GR),
-        pick(HR),
-        pick(CR),
-    )
 
 
 @jax.jit
@@ -200,16 +97,9 @@ def pos_update_kernel(bins, pos, node_feat, node_slot, node_child_base):
     return jnp.where(pos >= 0, new, -1)
 
 
-@jax.jit
-def tree_predict_kernel(bins_f32_scores, pos, leaf_vals):
-    """Add each active sample's leaf value to its score."""
-    safe = jnp.maximum(pos, 0)
-    return bins_f32_scores + jnp.where(pos >= 0, leaf_vals[safe], 0.0)
-
-
 @partial(jax.jit, static_argnames=("F", "B"))
 def node_hist_kernel(bins, in_node, g, h, F: int, B: int):
-    """(F, B, 3) histogram for one node's samples (loss-wise growth)."""
+    """(F, B, 3) histogram for one node's samples (host loss-wise growth)."""
     ids = jnp.where(in_node[:, None], jnp.arange(F)[None, :] * B + bins, F * B)
     vals = jnp.stack([g, h, jnp.where(in_node, 1.0, 0.0)], axis=1)
     n = bins.shape[0]
@@ -241,6 +131,9 @@ class GBDTTrainer:
         params: GBDTParams,
         mesh=None,
         fs: Optional[FileSystem] = None,
+        engine: str = "auto",
+        wave: Optional[int] = None,
+        use_bf16_hist: bool = True,
     ):
         self.params = params
         self.mesh = mesh
@@ -248,19 +141,383 @@ class GBDTTrainer:
         self.loss = create_loss(
             params.loss_function, {"sigmoid_zmax": params.sigmoid_zmax}
         )
-        self.gain_fn, self.node_value_fn = make_gain_fns(params)
+        cfg = self._cfg()
+        self.gain_fn, self.node_value_fn = make_gain_fns(*cfg)
         self.K = params.num_tree_in_group
+        if engine == "auto":
+            # LAD leaf refinement is host-side (TreeRefiner.java)
+            engine = "host" if (params.loss_function == "l1" and self.K == 1) else "device"
+        self.engine = engine
+        self.wave = wave
+        self.use_bf16_hist = use_bf16_hist
 
     def _put(self, arr):
         if self.mesh is None:
             return jax.device_put(arr)
         return jax.device_put(arr, row_sharding(self.mesh))
 
-    # -- tree building ----------------------------------------------------
+    def _put_cols(self, arr):
+        """Shard the trailing (sample) axis of a transposed matrix."""
+        if self.mesh is None:
+            return jax.device_put(arr)
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        return jax.device_put(
+            arr, NamedSharding(self.mesh, PartitionSpec(None, "data"))
+        )
 
     def _cfg(self):
         p = self.params
         return (p.l1, p.l2, p.min_child_hessian_sum, p.max_abs_leaf_val)
+
+    # -- entry ------------------------------------------------------------
+
+    def train(
+        self,
+        train: Optional[GBDTData] = None,
+        test: Optional[GBDTData] = None,
+    ) -> GBDTResult:
+        if self.engine == "device":
+            return self._train_device(train, test)
+        return self._train_host(train, test)
+
+    # ======================================================================
+    # DEVICE ENGINE
+    # ======================================================================
+
+    def _grow_spec(self, F: int, B: int) -> GrowSpec:
+        p = self.params
+        caps = []
+        if p.max_leaf_cnt > 0:
+            caps.append(2 * p.max_leaf_cnt - 1)
+        if p.max_depth > 0:
+            caps.append(2 ** (p.max_depth + 1) - 1)
+        if not caps:
+            raise ValueError("gbdt needs optimization.max_depth or max_leaf_cnt")
+        M = min(caps)
+        if self.wave is not None:
+            NW = self.wave
+        else:
+            NW = 64 if p.tree_grow_policy == "level" else 16
+        NW = max(1, min(NW, (M + 1) // 2))
+        force_dense = jax.default_backend() != "tpu" or (
+            self.mesh is not None and self.mesh.devices.size > 1
+        )
+        return GrowSpec(
+            F=F,
+            B=B,
+            max_nodes=M,
+            wave=NW,
+            policy=p.tree_grow_policy,
+            max_depth=p.max_depth,
+            max_leaves=p.max_leaf_cnt,
+            lr=p.learning_rate,
+            l1=p.l1,
+            l2=p.l2,
+            min_h=p.min_child_hessian_sum,
+            max_abs=p.max_abs_leaf_val,
+            min_split_loss=p.min_split_loss,
+            min_split_samples=float(p.min_split_samples),
+            use_bf16=self.use_bf16_hist,
+            force_dense=force_dense,
+        )
+
+    def _train_device(
+        self, train: Optional[GBDTData], test: Optional[GBDTData]
+    ) -> GBDTResult:
+        p = self.params
+        t0 = time.time()
+        if train is None:
+            train, test = GBDTIngest(p, self.fs).load()
+        n_real, F = train.n_real, train.n_features
+        K = self.K
+        self._missing_fill = train.missing_fill
+
+        log.info("building bins (%d features)...", F)
+        bins = build_bins(train.X, train.weight, p, train.feature_names)
+        B_real = bins.max_bins
+        B = max(8, 1 << (B_real - 1).bit_length())  # pad to pow2 for tiling
+        bins_np = bin_matrix(train.X, bins)
+        bins_t_np, n_pad = pad_inputs(bins_np)
+        bins_t = self._put_cols(bins_t_np)
+        y = self._put(_pad0(train.y, n_pad))
+        weight = self._put(_pad0(train.weight, n_pad))
+        real_mask = self._put(np.arange(n_pad) < train.X.shape[0])
+        log.info(
+            "load+preprocess %.1fs: %d rows, %d features, %d bins (pad %d)",
+            time.time() - t0, n_real, F, B_real, B,
+        )
+
+        spec = self._grow_spec(F, B)
+        M = spec.max_nodes
+        grow = make_grow_tree(spec)
+
+        base_np = self._base_score(train, K)
+        model = GBDTModel(
+            base_prediction=float(np.mean(base_np)),
+            num_tree_in_group=K,
+            obj_name=self.loss.name,
+        )
+        start_round = 0
+        model_path = p.model.data_path
+        if p.model.continue_train and self.fs.exists(model_path):
+            with self.fs.open(model_path) as f:
+                model = GBDTModel.loads(f.read())
+            start_round = len(model.trees) // K
+            log.info("continue_train: loaded %d trees", len(model.trees))
+
+        if K > 1:
+            scores = jnp.full((n_pad, K), base_np, jnp.float32)
+        else:
+            scores = jnp.full((n_pad,), float(base_np), jnp.float32)
+
+        aux_bins = ()
+        scores_t = None
+        y_t = w_t = None
+        if test is not None:
+            bins_test_np = bin_matrix(test.X, bins)
+            bt_np, nt_pad = pad_inputs(bins_test_np)
+            aux_bins = (self._put_cols(bt_np),)
+            y_t = self._put(_pad0(test.y, nt_pad))
+            w_t = self._put(_pad0(test.weight, nt_pad))
+            if K > 1:
+                scores_t = jnp.full((nt_pad, K), base_np, jnp.float32)
+            else:
+                scores_t = jnp.full((nt_pad,), float(base_np), jnp.float32)
+
+        # continue_train score replay through the host trees
+        if model.trees:
+            bins_dev = jnp.transpose(bins_t)
+            bins_test_dev = jnp.transpose(aux_bins[0]) if aux_bins else None
+            for i, t in enumerate(model.trees):
+                add = self._tree_scores_from_raw(t, bins, bins_dev)
+                scores = scores.at[:, i % K].add(add) if K > 1 else scores + add
+                if scores_t is not None:
+                    add_t = self._tree_scores_from_raw(t, bins, bins_test_dev)
+                    scores_t = (
+                        scores_t.at[:, i % K].add(add_t) if K > 1 else scores_t + add_t
+                    )
+            del bins_dev, bins_test_dev
+
+        # tree buffers for the whole run, written on device, fetched once
+        T = p.round_num * K
+        bufs = {
+            "feat": jnp.full((T, M), -1, jnp.int32),
+            "slot": jnp.zeros((T, M), jnp.int32),
+            "slot_r": jnp.zeros((T, M), jnp.int32),
+            "left": jnp.full((T, M), -1, jnp.int32),
+            "right": jnp.full((T, M), -1, jnp.int32),
+            "leaf": jnp.zeros((T, M), jnp.float32),
+            "gain": jnp.zeros((T, M), jnp.float32),
+            "hess": jnp.zeros((T, M), jnp.float32),
+            "cnt": jnp.zeros((T, M), jnp.float32),
+            "n_nodes": jnp.zeros((T,), jnp.int32),
+        }
+        loss_buf = jnp.zeros((p.round_num,), jnp.float32)
+        tloss_buf = jnp.zeros((p.round_num,), jnp.float32)
+
+        loss_fn = self.loss
+        inst_rate = p.instance_sample_rate
+        feat_rate = p.feature_sample_rate
+        has_test = test is not None
+        # big arrays ride as explicit args (closure capture would bake them
+        # into the program as constants); test arrays fold into `data`
+        data = (bins_t, y, weight, real_mask) + (
+            (aux_bins[0], y_t, w_t) if has_test else ()
+        )
+
+        def round_step(carry, rnd, key, data):
+            bins_t, y, weight, real_mask = data[:4]
+            aux_bins = (data[4],) if has_test else ()
+            y_t, w_t = (data[5], data[6]) if has_test else (None, None)
+            scores, scores_t, bufs, loss_buf, tloss_buf = carry
+            preds = loss_fn.predict(scores)
+            gs, hs = loss_fn.grad_hess(preds, y)
+            kf, ki = jax.random.split(key)
+            include = (weight > 0) & real_mask
+            if inst_rate < 1.0:
+                include &= jax.random.uniform(ki, (n_pad,)) <= inst_rate
+            if feat_rate < 1.0:
+                fmask = jax.random.uniform(kf, (F,)) <= feat_rate
+                fmask = fmask.at[0].set(fmask[0] | ~jnp.any(fmask))
+            else:
+                fmask = jnp.ones((F,), bool)
+
+            for grp in range(K):
+                g = (gs[:, grp] if K > 1 else gs) * weight
+                h = (hs[:, grp] if K > 1 else hs) * weight
+                tr, pos, aux_pos = grow(bins_t, include, g, h, fmask, aux=aux_bins)
+                add = tr.leaf[pos]
+                if K > 1:
+                    scores = scores.at[:, grp].add(add)
+                else:
+                    scores = scores + add
+                if has_test:
+                    add_t = tr.leaf[aux_pos[0]]
+                    if K > 1:
+                        scores_t = scores_t.at[:, grp].add(add_t)
+                    else:
+                        scores_t = scores_t + add_t
+                t_idx = rnd * K + grp
+                for name in (
+                    "feat", "slot", "slot_r", "left", "right",
+                    "leaf", "gain", "hess", "cnt",
+                ):
+                    arr = getattr(tr, name)
+                    bufs[name] = bufs[name].at[t_idx].set(
+                        arr.astype(bufs[name].dtype)
+                    )
+                bufs["n_nodes"] = bufs["n_nodes"].at[t_idx].set(tr.n_nodes)
+
+            per = jnp.where(weight > 0, loss_fn.loss(scores, y), 0.0)
+            loss_buf = loss_buf.at[rnd].set(
+                jnp.sum(weight * per) / jnp.maximum(jnp.sum(weight), 1e-12)
+            )
+            if has_test:
+                per_t = jnp.where(w_t > 0, loss_fn.loss(scores_t, y_t), 0.0)
+                tloss_buf = tloss_buf.at[rnd].set(
+                    jnp.sum(w_t * per_t) / jnp.maximum(jnp.sum(w_t), 1e-12)
+                )
+            return (scores, scores_t, bufs, loss_buf, tloss_buf)
+
+        jit_round = jax.jit(round_step, donate_argnums=(0,))
+        root_key = jax.random.PRNGKey(20170425)
+
+        if p.just_evaluate:
+            return self._finalize_device(
+                model, bins, scores, y, weight, scores_t, y_t, w_t,
+                bufs, loss_buf, tloss_buf, start_round, train.feature_names, t0,
+                trained_rounds=start_round,
+            )
+
+        carry = (scores, scores_t, bufs, loss_buf, tloss_buf)
+        sync_every = max(1, (p.round_num - start_round) // 20)
+        for rnd in range(start_round, p.round_num):
+            carry = jit_round(
+                carry, jnp.asarray(rnd), jax.random.fold_in(root_key, rnd), data
+            )
+            if (rnd + 1) % sync_every == 0 or rnd == p.round_num - 1:
+                tl = float(carry[3][rnd])  # syncs the pipeline
+                msg = f"[round={rnd}] {time.time()-t0:.1f}s train loss={tl:.6f}"
+                if has_test:
+                    msg += f" test loss={float(carry[4][rnd]):.6f}"
+                log.info(msg)
+            if p.model.dump_freq > 0 and (rnd + 1) % p.model.dump_freq == 0:
+                self._append_trees_from_bufs(
+                    model, carry[2], bins, train.feature_names,
+                    len(model.trees), (rnd + 1) * K,
+                )
+                self._dump_model(model)
+
+        scores, scores_t, bufs, loss_buf, tloss_buf = carry
+        return self._finalize_device(
+            model, bins, scores, y, weight, scores_t, y_t, w_t,
+            bufs, loss_buf, tloss_buf, start_round, train.feature_names, t0,
+            trained_rounds=p.round_num,
+        )
+
+    def _base_score(self, train: GBDTData, K: int):
+        p = self.params
+        if p.sample_dependent_base_prediction:
+            if K > 1:
+                mean = np.average(
+                    np.asarray(train.y[: train.n_real]),
+                    axis=0,
+                    weights=np.asarray(train.weight[: train.n_real]),
+                )
+                return np.asarray(self.loss.pred2score(jnp.asarray(mean)), np.float32)
+            mean = float(
+                np.average(
+                    train.y[: train.n_real], weights=train.weight[: train.n_real]
+                )
+            )
+            return np.float32(self.loss.pred2score(mean))
+        return np.float32(self.loss.pred2score(p.uniform_base_prediction))
+
+    def _append_trees_from_bufs(
+        self, model: GBDTModel, bufs, bins: FeatureBins, names, have: int, want: int
+    ) -> None:
+        """Convert device tree buffers [have, want) into host Trees."""
+        if want <= have:
+            return
+        host = {k: np.asarray(v) for k, v in bufs.items()}
+        for t_idx in range(have, want):
+            model.trees.append(
+                self._arrays_to_tree(
+                    {k: v[t_idx] for k, v in host.items()}, bins, names
+                )
+            )
+
+    def _arrays_to_tree(self, d: Dict[str, np.ndarray], bins, names) -> Tree:
+        nn = int(d["n_nodes"])
+        t = Tree()
+        t.feat = [int(v) for v in d["feat"][:nn]]
+        t.feat_name = [
+            (names[f] if (names and 0 <= f < len(names)) else str(f)) if f >= 0 else ""
+            for f in t.feat
+        ]
+        t.slot = [int(v) for v in d["slot"][:nn]]
+        t.split = [float(v) for v in d["slot_r"][:nn]]  # slot-space pre-convert
+        t.left = [int(v) for v in d["left"][:nn]]
+        t.right = [int(v) for v in d["right"][:nn]]
+        t.default_left = [True] * nn
+        t.leaf_value = [float(v) for v in d["leaf"][:nn]]
+        t.gain = [float(v) for v in d["gain"][:nn]]
+        t.hess_sum = [float(v) for v in d["hess"][:nn]]
+        t.sample_cnt = [int(round(float(v))) for v in d["cnt"][:nn]]
+        self._convert_tree(t, bins)
+        return t
+
+    def _finalize_device(
+        self, model, bins, scores, y, weight, scores_t, y_t, w_t,
+        bufs, loss_buf, tloss_buf, start_round, names, t0,
+        trained_rounds: int,
+    ) -> GBDTResult:
+        p = self.params
+        K = self.K
+        self._append_trees_from_bufs(
+            model, bufs, bins, names, len(model.trees), trained_rounds * K
+        )
+        if not p.just_evaluate:
+            self._dump_model(model)
+
+        eval_set = EvalSet(p.eval_metric, K=max(K, 2)) if p.eval_metric else None
+        res = GBDTResult(
+            model=model,
+            train_loss=float(_wavg_loss(self.loss, scores, y, weight)),
+            test_loss=(
+                float(_wavg_loss(self.loss, scores_t, y_t, w_t))
+                if scores_t is not None
+                else None
+            ),
+        )
+        loss_np = np.asarray(loss_buf)
+        tloss_np = np.asarray(tloss_buf)
+        for rnd in range(start_round, trained_rounds):
+            rec = {"round": rnd, "train_loss": float(loss_np[rnd])}
+            if scores_t is not None:
+                rec["test_loss"] = float(tloss_np[rnd])
+            res.round_log.append(rec)
+        if eval_set is not None:
+            res.train_metrics = eval_set.evaluate(
+                self.loss.predict(scores), y, weight
+            )
+            if scores_t is not None:
+                res.test_metrics = eval_set.evaluate(
+                    self.loss.predict(scores_t), y_t, w_t
+                )
+        log.info(
+            "training done in %.1fs: %d trees, train loss %.6f%s",
+            time.time() - t0,
+            len(model.trees),
+            res.train_loss,
+            f", test loss {res.test_loss:.6f}" if res.test_loss is not None else "",
+        )
+        return res
+
+    # ======================================================================
+    # HOST ENGINE (original implementation; reference for tests + LAD)
+    # ======================================================================
 
     def _decide_split(self, chg, cl, cr, hl, hr) -> bool:
         p = self.params
@@ -279,16 +536,17 @@ class GBDTTrainer:
         tree.slot[nid] = slot_l
         tree.split[nid] = float(slot_l)  # slot until convert
         left, right = tree.add_children(nid)
-        lr = self.params.learning_rate
-        tree.leaf_value[left] = float(self.node_value_fn(gl, hl)) * lr
-        tree.leaf_value[right] = float(self.node_value_fn(gr, hr)) * lr
+        # f32 multiply, bit-identical to the device engine's leaf values
+        lr = np.float32(self.params.learning_rate)
+        tree.leaf_value[left] = float(np.float32(self.node_value_fn(gl, hl)) * lr)
+        tree.leaf_value[right] = float(np.float32(self.node_value_fn(gr, hr)) * lr)
         tree.hess_sum[left], tree.sample_cnt[left] = float(hl), int(cl)
         tree.hess_sum[right], tree.sample_cnt[right] = float(hr), int(cr)
         return left, right
 
     def build_tree_level_wise(
         self, bins_dev, g, h, pos0, F: int, B: int, feat_mask, names
-    ) -> Tuple[Tree, jnp.ndarray]:
+    ) -> Tree:
         """Level-synchronous growth: one histogram scan + one split search +
         one position update per level (reference level policy,
         DataParallelTreeMaker.make with TreeGrowPolicy.LEVEL)."""
@@ -300,7 +558,10 @@ class GBDTTrainer:
         root_hist = hist_kernel(bins_dev, pos, g, h, 1, F, B)
         ghc = np.asarray(jnp.sum(root_hist, axis=(1, 2)))[0] / F  # sums counted F times
         tree.hess_sum[0], tree.sample_cnt[0] = float(ghc[1]), int(round(ghc[2]))
-        tree.leaf_value[0] = float(self.node_value_fn(ghc[0], ghc[1])) * p.learning_rate
+        tree.leaf_value[0] = float(
+            np.float32(self.node_value_fn(ghc[0], ghc[1]))
+            * np.float32(p.learning_rate)
+        )
         cfg = self._cfg()
         max_leaves = p.max_leaf_cnt if p.max_leaf_cnt > 0 else 1 << 30
 
@@ -364,7 +625,7 @@ class GBDTTrainer:
 
     def build_tree_loss_wise(
         self, bins_dev, g, h, pos_active, F: int, B: int, feat_mask, names
-    ) -> Tuple[Tree, jnp.ndarray]:
+    ) -> Tree:
         """Best-first growth with per-node histograms + sibling subtraction
         (reference TreeGrowPolicy.LOSS + HistogramPool)."""
         p = self.params
@@ -378,7 +639,9 @@ class GBDTTrainer:
         s = np.asarray(jnp.sum(root_hist[..., :], axis=(0, 1)))  # counted once per f
         Gt, Ht, Ct = s[0] / F, s[1] / F, s[2] / F
         tree.hess_sum[0], tree.sample_cnt[0] = float(Ht), int(round(Ct))
-        tree.leaf_value[0] = float(self.node_value_fn(Gt, Ht)) * p.learning_rate
+        tree.leaf_value[0] = float(
+            np.float32(self.node_value_fn(Gt, Ht)) * np.float32(p.learning_rate)
+        )
 
         def best_of(nid):
             out = split_kernel(hists[nid][None], feat_mask, cfg)
@@ -387,13 +650,14 @@ class GBDTTrainer:
         frontier = {0: best_of(0)}
         max_leaves = p.max_leaf_cnt if p.max_leaf_cnt > 0 else 1 << 30
         depth_of = {0: 0}
+        max_depth = p.max_depth if p.max_depth > 0 else 1 << 30
 
         while tree.leaf_cnt() < max_leaves:
             # pick the best expandable frontier node
             cand = [
                 (v[0], nid)
                 for nid, v in frontier.items()
-                if depth_of[nid] < p.max_depth
+                if depth_of[nid] < max_depth
                 and self._decide_split(v[0], v[5], v[8], v[4], v[7])
             ]
             if not cand:
@@ -438,9 +702,9 @@ class GBDTTrainer:
         depth = max(tree.max_depth(), 1)
         return _traverse_kernel(bins_dev, feat, slot, left, right, leaf, depth)
 
-    # -- boosting ---------------------------------------------------------
+    # -- host boosting -----------------------------------------------------
 
-    def train(
+    def _train_host(
         self,
         train: Optional[GBDTData] = None,
         test: Optional[GBDTData] = None,
@@ -470,26 +734,7 @@ class GBDTTrainer:
             B,
         )
 
-        # base score (reference: initPred — uniform or sample-dependent)
-        if p.sample_dependent_base_prediction:
-            if K > 1:
-                mean = np.average(
-                    np.asarray(train.y[: train.n_real]),
-                    axis=0,
-                    weights=np.asarray(train.weight[: train.n_real]),
-                )
-                base = self.loss.pred2score(jnp.asarray(mean))
-                base_np = np.asarray(base, np.float32)
-            else:
-                mean = float(
-                    np.average(
-                        train.y[: train.n_real], weights=train.weight[: train.n_real]
-                    )
-                )
-                base_np = np.float32(self.loss.pred2score(mean))
-        else:
-            base_np = np.float32(self.loss.pred2score(p.uniform_base_prediction))
-
+        base_np = self._base_score(train, K)
         model = GBDTModel(
             base_prediction=float(np.mean(base_np)),
             num_tree_in_group=K,
@@ -587,10 +832,10 @@ class GBDTTrainer:
                 model.trees.append(tree)
 
             rec = {"round": rnd, "elapsed": time.time() - t0}
-            rec["train_loss"] = self._avg_loss(scores, y, weight)
+            rec["train_loss"] = float(_wavg_loss(self.loss, scores, y, weight))
             if test_state is not None:
-                rec["test_loss"] = self._avg_loss(
-                    test_state[3], test_state[1], test_state[2]
+                rec["test_loss"] = float(
+                    _wavg_loss(self.loss, test_state[3], test_state[1], test_state[2])
                 )
             if eval_set is not None and (p.watch_train or p.watch_test or rnd == p.round_num - 1):
                 if p.watch_train:
@@ -619,10 +864,6 @@ class GBDTTrainer:
         )
 
     # -- helpers ----------------------------------------------------------
-
-    def _avg_loss(self, scores, y, weight) -> float:
-        per = jnp.where(weight > 0, self.loss.loss(scores, y), 0.0)
-        return float(jnp.sum(weight * per) / jnp.sum(weight))
 
     def _convert_tree(self, tree: Tree, bins: FeatureBins) -> None:
         """Slot interval -> real split value + default direction
@@ -719,7 +960,7 @@ class GBDTTrainer:
     ) -> GBDTResult:
         res = GBDTResult(
             model=model,
-            train_loss=self._avg_loss(scores, y, weight),
+            train_loss=float(_wavg_loss(self.loss, scores, y, weight)),
             test_loss=None,
             round_log=round_log,
         )
@@ -727,12 +968,24 @@ class GBDTTrainer:
             res.train_metrics = eval_set.evaluate(self.loss.predict(scores), y, weight)
         if test_state is not None:
             _, y_t, w_t, scores_t = test_state
-            res.test_loss = self._avg_loss(scores_t, y_t, w_t)
+            res.test_loss = float(_wavg_loss(self.loss, scores_t, y_t, w_t))
             if eval_set is not None:
                 res.test_metrics = eval_set.evaluate(
                     self.loss.predict(scores_t), y_t, w_t
                 )
         return res
+
+
+def _wavg_loss(loss, scores, y, weight):
+    per = jnp.where(weight > 0, loss.loss(scores, y), 0.0)
+    return jnp.sum(weight * per) / jnp.maximum(jnp.sum(weight), 1e-12)
+
+
+def _pad0(arr: np.ndarray, n_pad: int) -> np.ndarray:
+    n = arr.shape[0]
+    if n == n_pad:
+        return arr
+    return np.pad(arr, ((0, n_pad - n),) + ((0, 0),) * (arr.ndim - 1))
 
 
 @partial(jax.jit, static_argnames=("depth",))

@@ -128,15 +128,15 @@ def batch_predict_from_files(
     delim = predictor.params.data.delim
     fs = predictor.fs
     hook = load_transform_hook(py_transform_script) if need_py_transform else None
+
+    multiclass = model_name.lower() == "multiclass_linear"
+    if multiclass and K <= 0:
+        K = predictor.n_outputs
     eval_set = (
         EvalSet([m for m in eval_metric_str.split(",") if m], K=max(K, 2))
         if eval_metric_str
         else None
     )
-
-    multiclass = model_name.lower() == "multiclass_linear"
-    if multiclass and K <= 0:
-        K = predictor.n_outputs
     is_gbst = model_name.lower() in ("gbmlr", "gbsdt", "gbhmlr", "gbhsdt")
     is_gbdt = model_name.lower() == "gbdt"
     opt_cfg = predictor.config.get("optimization") or {}

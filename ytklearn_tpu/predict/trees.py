@@ -246,7 +246,9 @@ class GBSTPredictor(ContinuousPredictor):
         feats = self._feats_with_bias(features)
         z = self.base_score
         if other is not None:
-            z = float(self.loss.pred2score(float(other)))
+            # sample-dependent base ADDS to the uniform base score
+            # (reference: GBMLROnlinePredictor lbias += pred2Score(other))
+            z += float(self.loss.pred2score(float(other)))
         for t in range(self.n_trees):
             fx, _ = self._tree_fx_and_leaf(t, feats)
             z += self.lr * fx
