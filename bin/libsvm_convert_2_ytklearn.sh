@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# libsvm -> ytklearn converter (reference surface:
+# bin/libsvm_convert_2_ytklearn.sh + utils/LibsvmConvertTool.java:43).
+set -euo pipefail
+
+# make the package importable no matter where the script is invoked from
+REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+export PYTHONPATH="${REPO_ROOT}${PYTHONPATH:+:${PYTHONPATH}}"
+
+# mode: binary_classification@label0,label1
+#       | multi_classification@l0,l1,... | regression
+mode="${1:?usage: libsvm_convert_2_ytklearn.sh <mode> <libsvm_path> <out_path>}"
+libsvm_data_path="${2:?usage: libsvm_convert_2_ytklearn.sh <mode> <libsvm_path> <out_path>}"
+ytklearn_data_path="${3:?usage: libsvm_convert_2_ytklearn.sh <mode> <libsvm_path> <out_path>}"
+shift 3
+
+exec python -m ytklearn_tpu.cli convert "${mode}" "${libsvm_data_path}" "${ytklearn_data_path}" "$@"

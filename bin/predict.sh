@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# Offline batch predict (reference surface: bin/predict.sh:30-33).
+set -euo pipefail
+
+# make the package importable no matter where the script is invoked from
+REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+export PYTHONPATH="${REPO_ROOT}${PYTHONPATH:+:${PYTHONPATH}}"
+
+config_path="${1:?usage: predict.sh <config_path> <model_name> <file_dir> [extra args...]}"
+model_name="${2:?usage: predict.sh <config_path> <model_name> <file_dir> [extra args...]}"
+file_dir="${3:?usage: predict.sh <config_path> <model_name> <file_dir> [extra args...]}"
+shift 3
+
+# extra args: --save-mode M --suffix S --max-error-tol N
+#             --eval-metric "auc,mae" --predict-type value|leafid
+exec python -m ytklearn_tpu.cli predict "${config_path}" "${model_name}" "${file_dir}" "$@"

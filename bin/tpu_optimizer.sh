@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# Train launcher (reference surface: bin/local_optimizer.sh:38-47).
+# One host process drives the whole TPU mesh - no CommMaster rendezvous,
+# no per-slave JVMs; jax discovers the devices.
+set -euo pipefail
+
+# make the package importable no matter where the script is invoked from
+REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+export PYTHONPATH="${REPO_ROOT}${PYTHONPATH:+:${PYTHONPATH}}"
+
+# model name: linear | multiclass_linear | fm | ffm
+#             | gbmlr | gbsdt | gbhmlr | gbhsdt | gbdt
+model_name="${1:?usage: tpu_optimizer.sh <model_name> <config_path> [extra args...]}"
+properties_path="${2:?usage: tpu_optimizer.sh <model_name> <config_path> [extra args...]}"
+shift 2
+
+# data transform python script (reference: bin/transform.py hook);
+# pass --transform [--transform-script path] in the extra args to enable
+exec python -m ytklearn_tpu.cli train "${model_name}" "${properties_path}" "$@"

@@ -1,0 +1,44 @@
+"""Tuning matrix for the Pallas hist kernel at Higgs scale (10.5M x 28)."""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, __import__("os").path.dirname(__import__("os").path.dirname(__import__("os").path.abspath(__file__))))
+
+from ytklearn_tpu.gbdt.hist import _hist_pallas, pad_inputs
+
+
+def main():
+    rng = np.random.RandomState(0)
+    n, F, B = 10_500_000, 28, 256
+    bins = rng.randint(0, 255, size=(n, F)).astype(np.int32)
+    bins_t, n_pad = pad_inputs(bins, bm=16384)
+    bins_t = jnp.asarray(bins_t)
+    g = jnp.asarray(rng.randn(n_pad).astype(np.float32))
+    h = jnp.asarray(np.abs(rng.randn(n_pad)).astype(np.float32))
+    for N in (32, 42, 64):
+        pos = jnp.asarray(rng.randint(0, N, size=(n_pad,)).astype(np.int32))
+        ids = jnp.asarray(np.arange(N, dtype=np.int32))
+        for bm in (8192, 16384):
+            for fg in (4, 7, 14, 28):
+                if F % fg:
+                    continue
+                try:
+                    o = _hist_pallas(bins_t, pos, g, h, ids, B, bm, fg, True)
+                    jax.block_until_ready(o)
+                    t0 = time.perf_counter()
+                    for _ in range(3):
+                        o = _hist_pallas(bins_t, pos, g, h, ids, B, bm, fg, True)
+                    jax.block_until_ready(o)
+                    dt = (time.perf_counter() - t0) / 3
+                    print(f"N={N:3d} bm={bm:5d} fg={fg:2d}: {dt*1e3:7.1f} ms", flush=True)
+                except Exception as e:
+                    print(f"N={N:3d} bm={bm:5d} fg={fg:2d}: FAIL {type(e).__name__}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

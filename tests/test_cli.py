@@ -1,0 +1,160 @@
+"""CLI layer + libsvm converter + demo-parity smoke.
+
+Drives the bin/ surface end-to-end: convert the reference libsvm demo
+data, train via the CLI with unchanged reference configs (path overrides
+only), and batch-predict the result (reference: bin/local_optimizer.sh,
+bin/predict.sh, bin/libsvm_convert_2_ytklearn.sh)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from ytklearn_tpu.cli import convert_main, predict_main, train_main
+from ytklearn_tpu.io.libsvm import convert_libsvm
+
+REF = "/root/reference"
+
+
+def test_libsvm_convert_binary(tmp_path):
+    out = tmp_path / "agaricus.ytk"
+    cnt = convert_libsvm(
+        "binary_classification@0,1",
+        f"{REF}/demo/data/libsvm/agaricus.train.libsvm",
+        str(out),
+    )
+    assert cnt > 1000
+    lines = out.read_text().splitlines()
+    assert len(lines) == cnt
+    w, y, feats = lines[0].split("###")
+    assert w == "1" and y in ("0", "1")
+    assert all(":" in kv for kv in feats.split(","))
+    # matches the shipped pre-converted demo data line count
+    ref_lines = open(f"{REF}/demo/data/ytklearn/agaricus.train.ytklearn").read().splitlines()
+    assert len(ref_lines) == cnt
+
+
+def test_libsvm_convert_regression_and_unlabeled(tmp_path):
+    src = tmp_path / "r.libsvm"
+    src.write_text("1.5 1:2.0 3:1.0\n0:3.0 2:1.0\n-2.25 2:4.0\n")
+    out = tmp_path / "r.ytk"
+    cnt = convert_libsvm("regression", str(src), str(out))
+    assert cnt == 3
+    lines = out.read_text().splitlines()
+    assert lines[0] == "1###1.5###1:2.0,3:1.0"
+    assert lines[1] == "1######0:3.0,2:1.0"  # unlabeled keeps empty column
+    assert lines[2] == "1###-2.25###2:4.0"
+
+
+def test_libsvm_convert_multiclass_labels(tmp_path):
+    src = tmp_path / "m.libsvm"
+    src.write_text("a 1:1\nb 2:1\nc 1:1 2:1\n")
+    out = tmp_path / "m.ytk"
+    cnt = convert_libsvm("multi_classification@a,b,c", str(src), str(out))
+    assert cnt == 3
+    labels = [l.split("###")[1] for l in out.read_text().splitlines()]
+    assert labels == ["0", "1", "2"]
+    with pytest.raises(ValueError, match="unknown label"):
+        convert_libsvm("multi_classification@a,b", str(src), str(tmp_path / "x"))
+
+
+def test_cli_convert_train_predict_linear(tmp_path, capsys):
+    # convert the libsvm demo data through the CLI
+    train_ytk = tmp_path / "train.ytk"
+    rc = convert_main([
+        "binary_classification@0,1",
+        f"{REF}/demo/data/libsvm/agaricus.train.libsvm",
+        str(train_ytk),
+    ])
+    assert rc == 0
+
+    model_dir = tmp_path / "lr.model"
+    rc = train_main([
+        "linear",
+        f"{REF}/demo/linear/binary_classification/linear.conf",
+        "--set", f"data.train.data_path={train_ytk}",
+        "--set", "data.test.data_path=",
+        "--set", f"model.data_path={model_dir}",
+        "--set", "optimization.line_search.lbfgs.convergence.max_iter=8",
+    ])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["model"] == "linear"
+    assert out["avg_loss"] < 0.4
+    assert out["train_metrics"]["auc"] > 0.95
+    assert (model_dir / "model-00000").exists()
+
+    # batch predict through the CLI on the same config
+    pred_dir = tmp_path / "pin"
+    pred_dir.mkdir()
+    src = train_ytk.read_text().splitlines()
+    (pred_dir / "part-0").write_text("\n".join(src[:40]) + "\n")
+    rc = predict_main([
+        f"{REF}/demo/linear/binary_classification/linear.conf",
+        "linear",
+        str(pred_dir),
+        "--save-mode", "label_and_predict",
+        "--eval-metric", "auc",
+        "--set", f"model.data_path={model_dir}",
+    ])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["avg_loss"] > 0
+    assert len((pred_dir / "part-0_predict").read_text().splitlines()) == 40
+
+
+def test_cli_train_gbdt_demo(tmp_path, capsys):
+    train_ytk = tmp_path / "train.ytk"
+    convert_main([
+        "binary_classification@0,1",
+        f"{REF}/demo/data/libsvm/agaricus.train.libsvm",
+        str(train_ytk),
+    ])
+    rc = train_main([
+        "gbdt",
+        f"{REF}/demo/gbdt/binary_classification/local_gbdt.conf",
+        "--set", f"data.train.data_path={train_ytk}",
+        "--set", "data.test.data_path=",
+        "--set", f"model.data_path={tmp_path / 'gbdt.model'}",
+        "--set", "data.max_feature_dim=127",
+        "--set", "optimization.round_num=3",
+        "--set", "optimization.max_depth=4",
+        "--set", "optimization.watch_train=false",
+        "--set", "optimization.watch_test=false",
+    ])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["trees"] == 3
+    assert out["train_metrics"]["auc"] > 0.95
+    assert (tmp_path / "gbdt.model").exists()
+
+
+def test_cli_transform_hook(tmp_path, capsys):
+    """--transform runs each raw line through the python hook
+    (reference: Jython bin/transform.py, CoreData.java:298-311)."""
+    hook = tmp_path / "hook.py"
+    hook.write_text(
+        "def transform(raw):\n"
+        "    line = bytes(raw).decode()\n"
+        "    return [line.replace('REPLACEME', '1')]\n"
+    )
+    data = tmp_path / "t.ytk"
+    rng = np.random.RandomState(0)
+    lines = []
+    for _ in range(300):
+        x = rng.randn(2)
+        y = int(x[0] + x[1] > 0)
+        lines.append(f"REPLACEME###{y}###a:{x[0]:.4f},b:{x[1]:.4f}")
+    data.write_text("\n".join(lines) + "\n")
+    rc = train_main([
+        "linear",
+        f"{REF}/demo/linear/binary_classification/linear.conf",
+        "--transform", "--transform-script", str(hook),
+        "--set", f"data.train.data_path={data}",
+        "--set", "data.test.data_path=",
+        "--set", f"model.data_path={tmp_path / 'm'}",
+        "--set", "optimization.line_search.lbfgs.convergence.max_iter=10",
+    ])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["train_metrics"]["auc"] > 0.9

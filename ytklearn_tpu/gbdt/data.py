@@ -60,19 +60,34 @@ class GBDTIngest:
     """Parse ytklearn lines into the dense matrix; compute + apply the
     missing-value fill (reference: FillMissingValue.java:49,61)."""
 
-    def __init__(self, params: GBDTParams, fs: Optional[FileSystem] = None):
+    def __init__(
+        self,
+        params: GBDTParams,
+        fs: Optional[FileSystem] = None,
+        transform_hook=None,
+    ):
         self.params = params
         self.fs = fs or LocalFileSystem()
+        self.transform_hook = transform_hook
         if params.data.max_feature_dim <= 0:
             raise ValueError("gbdt requires data.max_feature_dim")
         self.F = params.data.max_feature_dim
         self.K = params.class_num if params.loss_function == "softmax" else 1
 
+    def _lines(self, paths):
+        """Raw lines, optionally expanded through the python transform hook
+        (reference: Jython transform, dataflow/CoreData.java:298-311)."""
+        for raw in self.fs.read_lines(paths):
+            if self.transform_hook is None:
+                yield raw
+            else:
+                yield from self.transform_hook(raw.encode())
+
     def _parse(self, paths, max_error_tol: int) -> GBDTData:
         delim = self.params.data.delim
         rows: List[Tuple[float, List[float], List[Tuple[int, float]]]] = []
         errors = 0
-        for line in self.fs.read_lines(paths):
+        for line in self._lines(paths):
             if not line.strip():
                 continue
             try:

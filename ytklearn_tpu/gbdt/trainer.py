@@ -334,7 +334,10 @@ class GBDTTrainer:
             preds = loss_fn.predict(scores)
             gs, hs = loss_fn.grad_hess(preds, y)
             kf, ki = jax.random.split(key)
-            include = (weight > 0) & real_mask
+            # weight-0 rows still count in the histogram count channel
+            # (weight folds into g/h only), matching the host engine and the
+            # reference's per-node sample counting
+            include = real_mask
             if inst_rate < 1.0:
                 include &= jax.random.uniform(ki, (n_pad,)) <= inst_rate
             if feat_rate < 1.0:
@@ -440,11 +443,13 @@ class GBDTTrainer:
         """Convert device tree buffers [have, want) into host Trees."""
         if want <= have:
             return
-        host = {k: np.asarray(v) for k, v in bufs.items()}
-        for t_idx in range(have, want):
+        # slice on device first: dump_freq checkpoints fetch only the new
+        # trees, not the whole (T, M) run buffers (D2H is ~115ms/transfer)
+        host = {k: np.asarray(v[have:want]) for k, v in bufs.items()}
+        for i in range(want - have):
             model.trees.append(
                 self._arrays_to_tree(
-                    {k: v[t_idx] for k, v in host.items()}, bins, names
+                    {k: v[i] for k, v in host.items()}, bins, names
                 )
             )
 
@@ -564,8 +569,9 @@ class GBDTTrainer:
         )
         cfg = self._cfg()
         max_leaves = p.max_leaf_cnt if p.max_leaf_cnt > 0 else 1 << 30
+        max_depth = p.max_depth if p.max_depth > 0 else 1 << 30
 
-        for depth in range(p.max_depth):
+        for depth in range(max_depth):
             n_nodes = len(level_nids)
             if n_nodes == 0:
                 break
@@ -584,7 +590,7 @@ class GBDTTrainer:
             for k in range(n_nodes):
                 nid = level_nids[k]
                 can = (
-                    depth < p.max_depth
+                    depth < max_depth
                     and leaves_after + 1 < max_leaves + 1
                     and self._decide_split(chg[k], CL[k], CR[k], HL[k], HR[k])
                 )
