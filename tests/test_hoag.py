@@ -1,0 +1,117 @@
+"""HOAG hypergradient hyper-optimization (reference:
+optimizer/HoagOptimizer.java:813-902 hyperHoagOptimization).
+
+Setup: an overfit-prone ridge problem (50 train rows, 15 features, noisy
+labels) where grid search shows a large λ₂ clearly beats a tiny one on
+test loss. HOAG starting from the tiny λ₂ must climb toward the better
+region and improve test loss over the unregularized round.
+"""
+
+import numpy as np
+import pytest
+
+from ytklearn_tpu.config import hocon
+from ytklearn_tpu.config.params import CommonParams
+from ytklearn_tpu.train import HoagTrainer
+
+REF = "/root/reference"
+LINEAR_CONF = f"{REF}/demo/linear/binary_classification/linear.conf"
+
+DIM = 15
+N_TRAIN = 50
+N_TEST = 400
+
+
+def _write_ds(path, X, y):
+    with open(path, "w") as f:
+        for row, lab in zip(X, y):
+            feats = ",".join(f"f{j}:{row[j]:.6g}" for j in range(DIM))
+            f.write(f"1###{lab:.6g}###{feats}\n")
+
+
+@pytest.fixture(scope="module")
+def ridge_files(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("hoag")
+    rng = np.random.RandomState(7)
+    w_true = rng.randn(DIM)
+    Xtr = rng.randn(N_TRAIN, DIM)
+    Xte = rng.randn(N_TEST, DIM)
+    ytr = Xtr @ w_true + 3.0 * rng.randn(N_TRAIN)  # noisy: OLS overfits
+    yte = Xte @ w_true + 3.0 * rng.randn(N_TEST)
+    _write_ds(tmp / "train.txt", Xtr, ytr)
+    _write_ds(tmp / "test.txt", Xte, yte)
+    return tmp
+
+
+def _params(ridge_files, tmp_path, **over):
+    cfg = hocon.load(LINEAR_CONF)
+    cfg = hocon.set_path(cfg, "data.train.data_path", str(ridge_files / "train.txt"))
+    cfg = hocon.set_path(cfg, "data.test.data_path", str(ridge_files / "test.txt"))
+    cfg = hocon.set_path(cfg, "model.data_path", str(tmp_path / "ridge.model"))
+    cfg = hocon.set_path(cfg, "loss.loss_function", "l2")
+    cfg = hocon.set_path(cfg, "loss.evaluate_metric", ["rmse"])
+    cfg = hocon.set_path(cfg, "loss.regularization.l1", [0.0])
+    cfg = hocon.set_path(cfg, "optimization.line_search.lbfgs.convergence.eps", 1e-6)
+    for k, v in over.items():
+        cfg = hocon.set_path(cfg, k, v)
+    return CommonParams.from_config(cfg)
+
+
+L2_START = 1e-4
+
+
+def test_hoag_moves_l2_toward_better_grid_point(ridge_files, tmp_path, mesh8):
+    # grid: the large-λ₂ point must clearly beat the tiny one on test loss
+    grid = _params(
+        ridge_files,
+        tmp_path,
+        **{
+            "hyper.switch_on": True,
+            "hyper.mode": "grid",
+            "hyper.restart": True,
+            "hyper.grid.l1": [0.0],
+            "hyper.grid.l2": [L2_START, 0.05],
+        },
+    )
+    res_grid = HoagTrainer(grid, "linear", mesh=mesh8).train()
+    assert res_grid.best_l2 == pytest.approx(0.05)
+
+    # HOAG from the small point climbs λ₂ (hypergradient says "more reg")
+    hoag = _params(
+        ridge_files,
+        tmp_path,
+        **{
+            "hyper.switch_on": True,
+            "hyper.mode": "hoag",
+            "hyper.restart": False,
+            "hyper.hoag.init_step": 2.0,
+            "hyper.hoag.step_decr_factor": 0.7,
+            "hyper.hoag.test_loss_reduce_limit": 1e-9,
+            "hyper.hoag.outer_iter": 10,
+            "hyper.hoag.l1": [0.0],
+            "hyper.hoag.l2": [L2_START],
+        },
+    )
+    res = HoagTrainer(hoag, "linear", mesh=mesh8).train()
+    final_l2 = float(np.max(res.best_l2))
+    assert final_l2 > L2_START * np.exp(2.0)  # climbed ≥ 1 log-step upward
+
+    # and the final round's test loss beats the starting-λ₂ round's
+    start_round_test = res.history[  # last iter of round 0 (λ₂ = start)
+        max(i for i, h in enumerate(res.history) if np.max(h["l2"]) <= L2_START * 1.01)
+    ]["test_loss"]
+    assert res.test_loss < start_round_test
+
+
+def test_hoag_requires_test_data(ridge_files, tmp_path, mesh8):
+    p = _params(
+        ridge_files,
+        tmp_path,
+        **{
+            "hyper.switch_on": True,
+            "hyper.mode": "hoag",
+            "data.test.data_path": "",
+        },
+    )
+    with pytest.raises(ValueError, match="hoag"):
+        HoagTrainer(p, "linear", mesh=mesh8).train()

@@ -144,21 +144,9 @@ def train_main(argv: Optional[List[str]] = None) -> int:
         }))
         return 0
 
-    from .io.reader import DataIngest
     from .train import HoagTrainer
 
-    kwargs = {}
-    if name == "multiclass_linear":
-        kwargs["n_labels"] = int(p.k)
-    elif name == "ffm":
-        from .models.ffm import load_field_dict
-        from .io.fs import LocalFileSystem
-
-        kwargs["field_map"] = load_field_dict(
-            LocalFileSystem(), p.model.field_dict_path
-        )
-    ingest = DataIngest(p, transform_hook=hook, **kwargs).load()
-    res = HoagTrainer(p, name, mesh=mesh).train(ingest=ingest)
+    res = HoagTrainer(p, name, mesh=mesh, transform_hook=hook).train()
     print(json.dumps({
         "model": name,
         "n_iter": res.n_iter,

@@ -1,1 +1,1 @@
-from .lbfgs import LBFGSConfig, LBFGSResult, minimize_lbfgs
+from .lbfgs import LBFGSConfig, LBFGSResult, inv_hessian_vp, minimize_lbfgs

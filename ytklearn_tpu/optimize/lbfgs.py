@@ -96,6 +96,7 @@ class LBFGSResult:
     n_iter: int
     status: str
     converged: bool
+    state: Optional[LBFGSState] = None  # final state (curvature history for HOAG)
 
 
 class Reg(NamedTuple):
@@ -104,6 +105,52 @@ class Reg(NamedTuple):
     l1_vec: jnp.ndarray  # (dim,) — zeros when no L1
     l2_vec: jnp.ndarray  # (dim,)
     g_weight: jnp.ndarray  # scalar total train weight
+
+
+def _two_loop_core(g, S, Y, ys_arr, cursor, hist_len, m: int):
+    """-H⁻¹·g via the two-loop recursion over the (m, dim) ring buffer
+    (reference: HoagOptimizer.Hv:904-929; history replicated here — on a
+    TPU mesh the dots are local FLOPs, so the reference's history-slice
+    sharding + allgather dance is unnecessary at these dims; for very
+    large dim shard w/S/Y over the mesh and XLA re-inserts the psums)."""
+    dtype = g.dtype
+    p = -g
+
+    def fwd(i, carry):
+        p, alphas = carry
+        idx = (cursor - 1 - i) % m
+        valid = i < hist_len
+        alpha = jnp.where(valid, jnp.vdot(S[idx], p) / ys_arr[idx], 0.0)
+        p = p - alpha * Y[idx]
+        return p, alphas.at[idx].set(alpha)
+
+    p, alphas = lax.fori_loop(0, m, fwd, (p, jnp.zeros((m,), dtype)))
+
+    newest = (cursor - 1) % m
+    yy_newest = jnp.vdot(Y[newest], Y[newest])
+    p = p * ys_arr[newest] / yy_newest
+
+    def bwd(j, p):
+        i = m - 1 - j  # oldest valid first
+        idx = (cursor - 1 - i) % m
+        valid = i < hist_len
+        beta = jnp.where(valid, jnp.vdot(Y[idx], p) / ys_arr[idx], 0.0)
+        return p + jnp.where(valid, alphas[idx] - beta, 0.0) * S[idx]
+
+    return lax.fori_loop(0, m, bwd, p)
+
+
+@partial(jax.jit, static_argnames=("m",))
+def inv_hessian_vp(state: LBFGSState, v, m: int):
+    """H⁻¹·v from a converged L-BFGS state's curvature history — the Hv
+    call HOAG uses to precondition the test gradient (reference:
+    HoagOptimizer.hyperHoagOptimization:822-826 -> Hv:904-929). Falls back
+    to identity when no history exists."""
+    return jnp.where(
+        state.hist_len > 0,
+        -_two_loop_core(v, state.S, state.Y, state.ys, state.cursor, state.hist_len, m),
+        v,
+    )
 
 
 def _loss_grad(pure_loss_fn, has_l1: bool, w, reg: Reg, batch):
@@ -235,36 +282,7 @@ def _build_programs(pure_loss_fn, config: LBFGSConfig, has_l1: bool):
         return w, g, loss, pure, status
 
     def two_loop(g, S, Y, ys_arr, cursor, hist_len):
-        """-H·g via the two-loop recursion over the (m, dim) ring buffer
-        (reference: HoagOptimizer.Hv:904-929; history replicated here — on a
-        TPU mesh the dots are local FLOPs, so the reference's history-slice
-        sharding + allgather dance is unnecessary at these dims; for very
-        large dim shard w/S/Y over the mesh and XLA re-inserts the psums)."""
-        dtype = g.dtype
-        p = -g
-
-        def fwd(i, carry):
-            p, alphas = carry
-            idx = (cursor - 1 - i) % m
-            valid = i < hist_len
-            alpha = jnp.where(valid, jnp.vdot(S[idx], p) / ys_arr[idx], 0.0)
-            p = p - alpha * Y[idx]
-            return p, alphas.at[idx].set(alpha)
-
-        p, alphas = lax.fori_loop(0, m, fwd, (p, jnp.zeros((m,), dtype)))
-
-        newest = (cursor - 1) % m
-        yy_newest = jnp.vdot(Y[newest], Y[newest])
-        p = p * ys_arr[newest] / yy_newest
-
-        def bwd(j, p):
-            i = m - 1 - j  # oldest valid first
-            idx = (cursor - 1 - i) % m
-            valid = i < hist_len
-            beta = jnp.where(valid, jnp.vdot(Y[idx], p) / ys_arr[idx], 0.0)
-            return p + jnp.where(valid, alphas[idx] - beta, 0.0) * S[idx]
-
-        return lax.fori_loop(0, m, bwd, p)
+        return _two_loop_core(g, S, Y, ys_arr, cursor, hist_len, m)
 
     @jax.jit
     def first_eval(w, reg, batch):
@@ -405,4 +423,5 @@ def _result(state, n_iter, status, converged=False) -> LBFGSResult:
         n_iter=n_iter,
         status=status,
         converged=converged,
+        state=state,
     )

@@ -27,7 +27,7 @@ from .eval import EvalSet
 from .io.fs import FileSystem, LocalFileSystem
 from .io.reader import DataIngest, IngestResult, SparseDataset
 from .models.linear import LinearModel
-from .optimize import LBFGSConfig, minimize_lbfgs
+from .optimize import LBFGSConfig, inv_hessian_vp, minimize_lbfgs
 from .parallel.mesh import row_sharding
 
 log = logging.getLogger("ytklearn_tpu.train")
@@ -60,12 +60,14 @@ class HoagTrainer:
         mesh=None,
         fs: Optional[FileSystem] = None,
         model_factory: Optional[Callable] = None,
+        transform_hook: Optional[Callable] = None,
     ):
         self.params = params
         self.model_name = model_name
         self.mesh = mesh
         self.fs = fs or LocalFileSystem()
         self.model_factory = model_factory
+        self.transform_hook = transform_hook
 
     def _ingest(self) -> IngestResult:
         """Model-aware ingest (reference: DataFlowFactory.createDataFlow:37-72
@@ -82,7 +84,9 @@ class HoagTrainer:
                 raise ValueError("ffm requires model.field_dict_path")
             self._field_map = load_field_dict(self.fs, p.model.field_dict_path)
             kwargs["field_map"] = self._field_map
-        return DataIngest(p, fs=self.fs, **kwargs).load()
+        return DataIngest(
+            p, fs=self.fs, transform_hook=self.transform_hook, **kwargs
+        ).load()
 
     def _make_model(self, ingest: IngestResult):
         dim = ingest.train.dim
@@ -170,16 +174,41 @@ class HoagTrainer:
                         jit_predicts(w, *test_b), test_b[-2], test_b[-1]
                     )
 
-        # hyper-search grid (reference grid rounds :457-765) or single run
+        # hyper-search (reference grid rounds :457-765 / HOAG :813-902) or
+        # a single run
+        hoag_mode = p.hyper.switch_on and p.hyper.mode == "hoag"
         if p.hyper.switch_on and p.hyper.mode == "grid":
             l1_grid = p.hyper.grid_l1 or [p.loss.l1[0]]
             l2_grid = p.hyper.grid_l2 or [p.loss.l2[0]]
             rounds = [(a, b) for a in l1_grid for b in l2_grid]
+        elif hoag_mode:
+            if test_b is None:
+                raise ValueError(
+                    "hyper.mode=hoag needs test data (data.test.data_path): the "
+                    "hypergradient is the test-loss gradient"
+                )
+            n_blocks = len(model.regular_blocks())
+            hoag_l1 = np.broadcast_to(
+                np.atleast_1d(np.asarray(p.hyper.hoag_l1, float)), (n_blocks,)
+            ).copy()
+            hoag_l2 = np.broadcast_to(
+                np.atleast_1d(np.asarray(p.hyper.hoag_l2, float)), (n_blocks,)
+            ).copy()
+            if p.hyper.hoag_outer_iter <= 0:
+                raise ValueError(
+                    f"hyper.hoag.outer_iter must be > 0, got {p.hyper.hoag_outer_iter}"
+                )
+            rounds = [(hoag_l1, hoag_l2)] * p.hyper.hoag_outer_iter
+            hoag_steps = np.full((n_blocks,), p.hyper.hoag_init_step)
+            hoag_grad_hist: List[np.ndarray] = []
+            hoag_delta_hist: List[float] = []
+            hoag_t_old = 0.0
+            jit_grad_test = jax.jit(jax.grad(model.pure_loss))
         else:
-            if p.hyper.switch_on and p.hyper.mode != "grid":
+            if p.hyper.switch_on:
                 log.warning(
-                    "hyper.mode=%r not implemented yet (grid only); running a "
-                    "single round at l1=%g l2=%g",
+                    "unknown hyper.mode=%r (grid|hoag); running a single round "
+                    "at l1=%g l2=%g",
                     p.hyper.mode,
                     p.loss.l1[0],
                     p.loss.l2[0],
@@ -194,7 +223,8 @@ class HoagTrainer:
         # continue_train warm start); restart=False: rounds carry the
         # previous round's solution (reference: HoagOptimizer.java:318,469)
         carry_w = w0
-        for l1, l2 in rounds:
+        for round_idx in range(len(rounds)):
+            l1, l2 = (hoag_l1, hoag_l2) if hoag_mode else rounds[round_idx]
             l1_vec, l2_vec = model.reg_vectors(l1, l2)
             start_w = w0 if p.hyper.restart else carry_w
 
@@ -243,19 +273,70 @@ class HoagTrainer:
             carry_w = np.asarray(res.w)
             # round selection: test loss when available, else the *pure*
             # train loss — the regularized loss would always prefer the
-            # smallest penalty (reference compares test loss, :489-500)
+            # smallest penalty (reference compares test loss, :489-500).
+            # In HOAG mode the final round wins (reference dumps the last w).
             tl = (
                 float(jit_loss(res.w, *test_b)) if test_b is not None else res.pure_loss
             )
-            if best is None or tl < best[0]:
+            if best is None or hoag_mode or tl < best[0]:
                 best = (tl, res, l1, l2)
             if len(rounds) > 1:
                 log.info(
-                    "[hyper l1=%g l2=%g] train loss %.6f test loss %s",
-                    l1,
-                    l2,
+                    "[hyper l1=%s l2=%s] train loss %.6f test loss %s",
+                    np.asarray(l1),
+                    np.asarray(l2),
                     res.loss / g_weight,
                     tl / max(g_weight_test, 1e-12) if test_b is not None else "n/a",
+                )
+
+            if hoag_mode:
+                # ---- HOAG hypergradient step on log λ₂ (reference:
+                # HoagOptimizer.hyperHoagOptimization:813-902) ----
+                tl_avg = tl / max(g_weight_test, 1e-12)
+                gtest = jit_grad_test(res.w, *test_b) / g_weight_test
+                q = np.asarray(inv_hessian_vp(res.state, gtest, cfg.m))
+                w_np = np.asarray(res.w)
+                grad_log_l2 = np.zeros_like(hoag_l2)
+                for r, (s, e) in enumerate(model.regular_blocks()):
+                    if hoag_l2[r] > 0.0:
+                        grad_log_l2[r] = (
+                            -hoag_l2[r] * g_weight * float(np.dot(w_np[s:e], q[s:e]))
+                        )
+                hoag_delta_hist.append(tl_avg - hoag_t_old)
+                hoag_t_old = tl_avg
+                hoag_grad_hist.append(grad_log_l2)
+                # step shrink on hypergradient sign flip (:845-857)
+                if len(hoag_grad_hist) >= 2:
+                    prev = hoag_grad_hist[-2]
+                    flip = prev * grad_log_l2 < 0.0
+                    hoag_steps = np.where(
+                        flip & (hoag_l2 > 0.0),
+                        hoag_steps * p.hyper.hoag_step_decr_factor,
+                        hoag_steps,
+                    )
+                # stop when the last-3 average |Δtest loss| stalls (:860-876)
+                if len(hoag_delta_hist) >= 3:
+                    sumdelta = float(np.mean(np.abs(hoag_delta_hist[-3:])))
+                    if sumdelta < p.hyper.hoag_test_loss_reduce_limit:
+                        log.info(
+                            "[hoag] last 3 avg test loss delta %.3g < %g, exit! "
+                            "final l2: %s",
+                            sumdelta,
+                            p.hyper.hoag_test_loss_reduce_limit,
+                            hoag_l2,
+                        )
+                        break
+                # signed step on log λ₂ (:885-895)
+                upd = hoag_l2 > 0.0
+                logl2 = np.where(upd, np.log(np.where(upd, hoag_l2, 1.0)), 0.0)
+                logl2 = logl2 + np.where(-grad_log_l2 >= 0.0, hoag_steps, -hoag_steps)
+                hoag_l2 = np.where(upd, np.exp(logl2), hoag_l2)
+                log.info(
+                    "[hoag round %d] test avg loss %.6f hypergrad %s new l2 %s",
+                    round_idx,
+                    tl_avg,
+                    grad_log_l2,
+                    hoag_l2,
                 )
 
         tl, res, bl1, bl2 = best
