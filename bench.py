@@ -1,24 +1,27 @@
-"""Single-chip TPU benchmark. Prints ONE JSON line:
-{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+"""Single-chip TPU benchmark on the reference's headline axis. Prints ONE
+JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
-Headline (until the GBDT stack lands): full L-BFGS iterations/sec for the
-linear+sigmoid kernel on synthetic dense data (4M rows x 256 features, the
-MXU matmul path) — each iteration = line-search trials x (fused Xv + loss +
-XTv grad) as one XLA program, exactly what drives every convex family.
+Measures GBDT boosting throughput (trees/sec) at the Higgs acceptance
+config (reference experiment/higgs/local_gbdt.conf: loss-wise growth,
+255 leaves, 255 bins, lr 0.1, min_child_hessian 100, sigmoid loss) on a
+Higgs-shaped dataset (10.5M rows x 28 features; synthetic with a planted
+nonlinear signal since the real download isn't available in this image).
 
-vs_baseline: the reference publishes no linear-model numbers (BASELINE.md
-covers GBDT only), so the comparator is an engineering estimate of the
-reference's Java path on its benchmark hardware (16-thread Xeon E5-2640v3):
-the dense Xv/XTv loops stream ~2 GB per pass at ~10 GB/s effective
-(java float[] + per-sample virtual loss calls), ~4 passes per iteration
-=> ~1.2 iter/s on 4M x 256. Will be replaced by the published GBDT
-trees/sec baseline (0.88 trees/s, docs/gbdt_experiments.md) once the GBDT
-stack is benchable.
+vs_baseline: the reference's published speed on this config is 500 trees
+in 567.83 s = 0.88 trees/s on 2x Xeon E5-2640 v3, 16 threads
+(docs/gbdt_experiments.md "Result -> Speed"; same table in BASELINE.md).
+
+Timing is steady-state: the per-round sync log excludes data generation,
+binning, and the one-time XLA compile of the tree-growth program (the
+reference number likewise excludes its 35 s load+preprocess phase).
+A persistent compilation cache under .jax_cache makes repeat runs cheap.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import sys
 import time
 
 import numpy as np
@@ -26,52 +29,71 @@ import numpy as np
 
 def main() -> None:
     import jax
-    import jax.numpy as jnp
 
-    from ytklearn_tpu.losses import create_loss
-    from ytklearn_tpu.optimize import LBFGSConfig, minimize_lbfgs
+    os.makedirs(".jax_cache", exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", os.path.abspath(".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
 
-    n, dim = 4_000_000, 256
+    from ytklearn_tpu.config.params import ApproximateSpec, GBDTParams, ModelParams
+    from ytklearn_tpu.gbdt.data import GBDTData
+    from ytklearn_tpu.gbdt.trainer import GBDTTrainer
+
+    n = int(os.environ.get("BENCH_ROWS", 10_500_000))
+    n_trees = int(os.environ.get("BENCH_TREES", 40))
+    F = 28
+
+    t0 = time.time()
     rng = np.random.RandomState(0)
-    X_np = rng.randn(n, dim).astype(np.float32)
-    w_true = (rng.randn(dim) * 0.3).astype(np.float32)
-    y_np = (X_np @ w_true + 0.5 * rng.randn(n) > 0).astype(np.float32)
+    X = rng.randn(n, F).astype(np.float32)
+    logit = (
+        1.5 * X[:, 0] * X[:, 1]
+        + np.sin(X[:, 2] * 2)
+        + 0.8 * (X[:, 3] > 0.5)
+        - 0.5 * X[:, 4] ** 2
+        + 0.3 * X[:, 5] * X[:, 6]
+    )
+    y = (logit + rng.randn(n) * 0.5 > 0).astype(np.float32)
+    train = GBDTData(
+        X=X, y=y, weight=np.ones(n, np.float32), n_real=n,
+        feature_names=[f"f{i}" for i in range(F)],
+    )
+    print(f"data gen {time.time()-t0:.1f}s", file=sys.stderr)
 
-    X = jax.device_put(X_np)
-    y = jax.device_put(y_np)
-    weight = jnp.ones((n,), jnp.float32)
-    loss = create_loss("sigmoid")
+    params = GBDTParams(
+        round_num=n_trees,
+        max_depth=60,
+        max_leaf_cnt=255,
+        tree_grow_policy="loss",
+        learning_rate=0.1,
+        min_child_hessian_sum=100.0,
+        loss_function="sigmoid",
+        eval_metric=[],
+        approximate=[ApproximateSpec(type="sample_by_quantile", max_cnt=255)],
+        model=ModelParams(data_path="/tmp/bench_gbdt_model", dump_freq=0),
+    )
+    trainer = GBDTTrainer(params, engine="device")
+    res = trainer.train(train=train)
+    assert np.isfinite(res.train_loss) and res.train_loss < 0.65
+    assert len(res.model.trees) == n_trees
 
-    def pure_loss(w, X, y, weight):
-        return jnp.sum(weight * loss.loss(X @ w, y))
+    # steady-state trees/s from the sync log, skipping the compile-laden
+    # first syncs (use the window from the first sync at round >= 3)
+    sync = trainer.sync_log
+    tail = [(r, t) for r, t in sync if r >= 3]
+    if len(tail) >= 2:
+        (r0, t0s), (r1, t1s) = tail[0], tail[-1]
+        trees_per_sec = (r1 - r0) / (t1s - t0s)
+    else:  # tiny BENCH_TREES fallback: whole-run average
+        trees_per_sec = n_trees / sync[-1][1]
 
-    def run(iters):
-        c = LBFGSConfig(max_iter=iters, m=8, eps=0.0, mode="wolfe")
-        return minimize_lbfgs(
-            pure_loss,
-            jnp.zeros(dim, jnp.float32),
-            c,
-            batch=(X, y, weight),
-            g_weight=float(n),
-        )
-
-    run(1)  # compile (programs are cached by (loss_fn, config) -> reused below)
-    run(1)  # warm
-    t0 = time.perf_counter()
-    n_iters = 20
-    res = run(n_iters)
-    dt = time.perf_counter() - t0
-    iters_per_sec = n_iters / dt
-    assert np.isfinite(res.loss)
-
-    ref_estimate = 1.2  # see module docstring
+    ref_trees_per_sec = 0.88  # docs/gbdt_experiments.md, 500 trees / 567.83s
     print(
         json.dumps(
             {
-                "metric": "linear_lbfgs_iter_per_sec_4Mx256",
-                "value": round(iters_per_sec, 3),
-                "unit": "iter/s",
-                "vs_baseline": round(iters_per_sec / ref_estimate, 2),
+                "metric": "gbdt_trees_per_sec_higgs10.5M_losswise_255leaves",
+                "value": round(trees_per_sec, 3),
+                "unit": "trees/s",
+                "vs_baseline": round(trees_per_sec / ref_trees_per_sec, 2),
             }
         )
     )

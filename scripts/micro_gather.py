@@ -1,0 +1,91 @@
+"""Measure the building blocks of a compact-gather histogram wave:
+  1. membership mask + cumsum + searchsorted-compaction (indices of the
+     wave's samples)
+  2. column gather of the bin matrix at those indices
+  3. gather of g/h at those indices
+All chained inside one program (K reps), one scalar fetched.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from functools import partial
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+K = 10
+
+
+def timed(label, fn, *args):
+    r = fn(*args)
+    float(jax.tree_util.tree_leaves(r)[0].ravel()[0])
+    t0 = time.perf_counter()
+    r = fn(*args)
+    float(jax.tree_util.tree_leaves(r)[0].ravel()[0])
+    dt = (time.perf_counter() - t0) / K
+    print(f"{label:44s} {dt*1e3:8.1f} ms", flush=True)
+
+
+@partial(jax.jit, static_argnames=("cap",))
+def compact_idx(pos, ids, cap: int):
+    def body(i, carry):
+        acc, p = carry
+        m = jnp.any(p[:, None] == ids[None, :], axis=1)
+        cum = jnp.cumsum(m.astype(jnp.int32))
+        sel = jnp.searchsorted(cum, jnp.arange(1, cap + 1, dtype=jnp.int32))
+        return acc + sel[0], p + (sel[0] * 0)
+
+    acc, _ = jax.lax.fori_loop(0, K, body, (jnp.zeros((), jnp.int32), pos))
+    return acc
+
+
+@partial(jax.jit, static_argnames=("cap",))
+def gather_cols(bins_t, idx, cap: int):
+    def body(i, carry):
+        acc, ix = carry
+        sub = jnp.take(bins_t, ix, axis=1)  # (F, cap)
+        s = sub[0, 0]
+        return acc + s, ix + (s * 0)
+
+    acc, _ = jax.lax.fori_loop(0, K, body, (jnp.zeros((), jnp.int32), idx))
+    return acc
+
+
+@partial(jax.jit, static_argnames=())
+def gather_vec(g, idx):
+    def body(i, carry):
+        acc, ix = carry
+        sub = jnp.take(g, ix)
+        s = sub[0]
+        return acc + s, ix + (s * 0).astype(jnp.int32)
+
+    acc, _ = jax.lax.fori_loop(0, K, body, (jnp.zeros(()), idx))
+    return acc
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 10_485_760
+    cap = n // 2
+    F = 28
+    rng = np.random.RandomState(0)
+    bins_t = jnp.asarray(rng.randint(0, 255, size=(F, n)).astype(np.int32))
+    g = jnp.asarray(rng.randn(n).astype(np.float32))
+    pos = jnp.asarray(rng.randint(0, 509, size=(n,)).astype(np.int32))
+    ids = jnp.asarray(np.arange(16, dtype=np.int32) * 3)
+    idx = jnp.asarray(np.sort(rng.choice(n, size=cap, replace=False)).astype(np.int32))
+    print(f"n={n} cap={cap}", flush=True)
+
+    timed("compact: mask+cumsum+searchsorted", compact_idx, pos, ids, cap)
+    timed("gather bins_t cols (F x n/2)", gather_cols, bins_t, idx, cap)
+    timed("gather g (n/2)", gather_vec, g, idx)
+
+
+if __name__ == "__main__":
+    main()

@@ -6,6 +6,7 @@ Prints per-tree timing and final train/test quality.
 
 from __future__ import annotations
 
+import logging
 import os
 import sys
 import time
@@ -13,6 +14,8 @@ import time
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+logging.basicConfig(level=logging.INFO, stream=sys.stdout)
 
 
 def main() -> None:

@@ -1,0 +1,70 @@
+"""Device binning (sort + rank-pick + compare-count) must reproduce the
+host sampler/converter bit-for-bit — it replaces the host path for the
+single-device acceptance config (sample_by_quantile)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from ytklearn_tpu.config.params import ApproximateSpec, GBDTParams, ModelParams
+from ytklearn_tpu.gbdt.binning import (
+    bin_matrix,
+    bin_matrix_device,
+    build_bins,
+    build_bins_maybe_device,
+)
+
+
+def _params(max_cnt):
+    return GBDTParams(
+        approximate=[ApproximateSpec(type="sample_by_quantile", max_cnt=max_cnt)],
+        model=ModelParams(data_path="/tmp/unused"),
+    )
+
+
+def _mkX(n, rng):
+    cont = rng.randn(n, 3).astype(np.float32)  # continuous
+    dup = np.round(rng.randn(n, 2) * 2).astype(np.float32)  # heavy ties
+    smallcard = rng.randint(0, 7, size=(n, 1)).astype(np.float32)  # < max_cnt
+    return np.concatenate([cont, dup, smallcard], axis=1)
+
+
+def test_uniform_weights_match_host():
+    rng = np.random.RandomState(0)
+    X = _mkX(5000, rng)
+    w = np.ones(X.shape[0], np.float32)
+    p = _params(31)
+    host = build_bins(X, w, p)
+    dev = build_bins_maybe_device(X, jnp.asarray(X.T), w, p)
+    assert host.max_bins == dev.max_bins
+    np.testing.assert_array_equal(host.counts, dev.counts)
+    np.testing.assert_array_equal(host.values, dev.values)
+
+    bm_host = bin_matrix(X, host)
+    bm_dev = np.asarray(bin_matrix_device(jnp.asarray(X.T), dev)).T
+    np.testing.assert_array_equal(bm_host, bm_dev)
+
+
+def test_weighted_match_host():
+    rng = np.random.RandomState(1)
+    X = _mkX(4000, rng)
+    w = rng.rand(X.shape[0]).astype(np.float32) * 3.0
+    p = _params(17)
+    p.approximate[0].use_sample_weight = True
+    p.approximate[0].alpha = 1.0
+    host = build_bins(X, w, p)
+    dev = build_bins_maybe_device(X, jnp.asarray(X.T), w, p)
+    np.testing.assert_array_equal(host.counts, dev.counts)
+    np.testing.assert_array_equal(host.values, dev.values)
+
+
+def test_non_quantile_spec_falls_back():
+    rng = np.random.RandomState(2)
+    X = _mkX(1000, rng)
+    w = np.ones(X.shape[0], np.float32)
+    p = GBDTParams(
+        approximate=[ApproximateSpec(type="sample_by_cnt", max_cnt=25)],
+        model=ModelParams(data_path="/tmp/unused"),
+    )
+    host = build_bins(X, w, p)
+    dev = build_bins_maybe_device(X, jnp.asarray(X.T), w, p)
+    np.testing.assert_array_equal(host.values, dev.values)

@@ -1,0 +1,67 @@
+"""Feature-parallel exact-greedy maker (tree_maker=feature): columns
+sharded over the 8-device mesh must grow the SAME trees as the
+data-parallel level-wise maker (reference:
+FeatureParallelTreeMakerByLevel.java vs DataParallelTreeMaker.java — two
+search layouts over one search space)."""
+
+import numpy as np
+import pytest
+
+from ytklearn_tpu.config.params import ApproximateSpec, GBDTParams, ModelParams
+from ytklearn_tpu.gbdt.data import GBDTData
+from ytklearn_tpu.gbdt.trainer import GBDTTrainer
+
+
+def _data(n=3000, F=10, seed=3):
+    rng = np.random.RandomState(seed)
+    # integer-ish values -> small exact bin sets (no_sample), well-separated
+    # gains so float-order differences can't flip an argmax
+    X = rng.randint(0, 12, size=(n, F)).astype(np.float32)
+    logit = 1.2 * (X[:, 0] > 6) - 0.9 * (X[:, 1] < 4) + 0.4 * (X[:, 2] > 8)
+    y = (logit + 0.3 * rng.randn(n) > 0).astype(np.float32)
+    return GBDTData(
+        X=X, y=y, weight=np.ones(n, np.float32), n_real=n,
+        feature_names=[f"f{i}" for i in range(F)],
+    )
+
+
+def _params(tmp_path, maker, **over):
+    kw = dict(
+        round_num=3,
+        max_depth=4,
+        max_leaf_cnt=0,
+        tree_grow_policy="level",
+        tree_maker=maker,
+        learning_rate=0.3,
+        min_child_hessian_sum=1.0,
+        loss_function="sigmoid",
+        eval_metric=["auc"],
+        approximate=[ApproximateSpec(type="no_sample")],
+        model=ModelParams(data_path=str(tmp_path / f"m_{maker}.model"), dump_freq=0),
+    )
+    kw.update(over)
+    return GBDTParams(**kw)
+
+
+def test_feature_parallel_matches_data_parallel(tmp_path, mesh8):
+    train = _data()
+    res_d = GBDTTrainer(
+        _params(tmp_path, "data"), mesh=mesh8, engine="host"
+    ).train(train=train)
+    res_f = GBDTTrainer(_params(tmp_path, "feature"), mesh=mesh8).train(train=train)
+
+    assert len(res_d.model.trees) == len(res_f.model.trees)
+    for td, tf in zip(res_d.model.trees, res_f.model.trees):
+        assert td.feat == tf.feat
+        assert td.left == tf.left and td.right == tf.right
+        np.testing.assert_allclose(td.split, tf.split, rtol=1e-6)
+        np.testing.assert_allclose(td.leaf_value, tf.leaf_value, rtol=2e-4, atol=1e-6)
+    assert res_f.train_loss == pytest.approx(res_d.train_loss, rel=1e-4)
+    assert res_f.train_metrics["auc"] == pytest.approx(
+        res_d.train_metrics["auc"], abs=1e-4
+    )
+
+
+def test_feature_parallel_auto_engine_is_host(tmp_path, mesh8):
+    t = GBDTTrainer(_params(tmp_path, "feature"), mesh=mesh8)
+    assert t.engine == "host"

@@ -83,8 +83,20 @@ class GBDTIngest:
             else:
                 yield from self.transform_hook(raw.encode())
 
-    def _parse(self, paths, max_error_tol: int) -> GBDTData:
+    def _parse(
+        self,
+        paths,
+        max_error_tol: int,
+        fmap: Optional[Dict[str, int]] = None,
+        frozen: bool = False,
+    ) -> GBDTData:
+        """fmap: feature name -> dense column, grown in first-seen order while
+        parsing train data, frozen for test data — the reference's
+        OnlineFeatureMap (GBDTCoreData.java:371-381: unseen test features are
+        skipped, train overflow past max_feature_dim is a checked error)."""
         delim = self.params.data.delim
+        if fmap is None:
+            fmap = {}
         rows: List[Tuple[float, List[float], List[Tuple[int, float]]]] = []
         errors = 0
         for line in self._lines(paths):
@@ -92,10 +104,23 @@ class GBDTIngest:
                 continue
             try:
                 pl = parse_line(line, delim)
-                feats = [(int(name), v) for name, v in pl.feats]
-                for fid, _ in feats:
-                    if fid >= self.F:
-                        raise ValueError(f"feature id {fid} >= max_feature_dim {self.F}")
+                feats = []
+                staged: Dict[str, int] = {}  # new names held until the whole
+                for name, v in pl.feats:  # line parses clean (error-tol lines
+                    idx = fmap.get(name)  # must not claim dense columns)
+                    if idx is None:
+                        idx = staged.get(name)
+                    if idx is None:
+                        if frozen:
+                            continue  # test-only feature: ignored
+                        idx = len(fmap) + len(staged)
+                        if idx >= self.F:
+                            raise ValueError(
+                                f"max_feature_dim({self.F}) smaller than real "
+                                f"feature number in data set (feature {name!r})"
+                            )
+                        staged[name] = idx
+                    feats.append((idx, v))
                 labels = pl.labels
                 if self.K > 1:
                     if len(labels) == 1:
@@ -109,8 +134,10 @@ class GBDTIngest:
                 if errors > max_error_tol:
                     raise
                 continue
+            fmap.update(staged)
             rows.append((pl.weight, labels, feats))
 
+        self._fmap = fmap
         n = len(rows)
         X = np.full((n, self.F), np.nan, np.float32)
         weight = np.empty((n,), np.float32)
@@ -127,6 +154,8 @@ class GBDTIngest:
             for fid, v in feats:
                 X[i, fid] = v
         names = [str(i) for i in range(self.F)]
+        for name, idx in fmap.items():
+            names[idx] = name
         return GBDTData(X=X, y=y, weight=weight, n_real=n, feature_names=names)
 
     def compute_missing_fill(self, X: np.ndarray) -> np.ndarray:
@@ -158,7 +187,10 @@ class GBDTIngest:
         _apply_fill(train.X, fill)
         test = None
         if p.data.test_paths:
-            test = self._parse(p.data.test_paths, p.data.test_max_error_tol)
+            test = self._parse(
+                p.data.test_paths, p.data.test_max_error_tol,
+                fmap=self._fmap, frozen=True,
+            )
             test.missing_fill = fill
             _apply_fill(test.X, fill)
         return train, test

@@ -1,0 +1,238 @@
+"""Feature-parallel exact-greedy tree maker — columns sharded over the mesh.
+
+Rebuild of reference optimizer/gbdt/FeatureParallelTreeMakerByLevel.java:147
+(threads own column ranges; gradients allgathered :274; per-node best split
+merged across owners :407; positions shared :443), re-architected for the
+mesh: the bin matrix lives transposed (F_pad, n) with the FEATURE axis
+sharded over the mesh's data axis, every device holds all samples of its
+feature slice, and the per-node best-split merge is `pargmax_tuple` — the
+dense-tuple replacement for the reference's Kryo SplitInfo object-allreduce
+(data/gbdt/SplitInfo.needReplace:99 tie-break: equal gains go to the lower
+rank, i.e. the lower global feature id, matching the data-parallel maker's
+first-max flat argmax).
+
+Gradients/positions arrive replicated: entering shard_map with in_spec P()
+on row-sharded g/h is XLA's all_gather — the same wire traffic the
+reference issued by hand at :274/:443.
+
+Growth is level-synchronous on the host (one jitted sharded step per
+level), mirroring GBDTTrainer.build_tree_level_wise so the two makers grow
+identical trees on identical inputs.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..parallel.collectives import pargmax_tuple
+from ..parallel.mesh import DATA_AXIS
+from .engine import split_kernel
+from .hist import hist_wave
+from .tree import Tree
+
+
+def shard_features(mesh, bins_np: np.ndarray):
+    """(n, F) host bin matrix -> (F_pad, n) device array, features sharded.
+
+    F pads to a mesh-size multiple with all-zero pseudo-features (masked out
+    of split search; reference pads column ranges the same way via avgAssign,
+    dataflow/GBDTDataFlow.java:240-279)."""
+    D = mesh.devices.size
+    n, F = bins_np.shape
+    F_pad = (F + D - 1) // D * D
+    bt = np.zeros((F_pad, n), np.int32)
+    bt[:F] = bins_np.T
+    return jax.device_put(bt, NamedSharding(mesh, P(DATA_AXIS, None))), F_pad
+
+
+_PROGRAMS: dict = {}
+
+
+def _cached(kind: str, key, builder):
+    full = (kind,) + key
+    if full not in _PROGRAMS:
+        _PROGRAMS[full] = builder()
+    return _PROGRAMS[full]
+
+
+def _make_level_step(mesh, F_pad: int, B: int, cfg, n_nodes: int):
+    """One level: local hist over owned features -> local best split per
+    node -> global pargmax merge. Returns per-node global split fields."""
+    D = mesh.devices.size
+    F_loc = F_pad // D
+
+    def step(bins_local, pos, g, h, feat_mask_local):
+        node_ids = jnp.arange(n_nodes, dtype=jnp.int32)
+        # f32 accumulation: this maker is the exactness-focused one (bf16
+        # would desync its gains from the data-parallel maker's f32 scatter)
+        hist = hist_wave(
+            bins_local, pos, g, h, node_ids, B, use_bf16=False, force_dense=True
+        )  # (N, F_loc, B, 3)
+        out = split_kernel(hist, feat_mask_local, cfg)
+        (chg, flat, slotl, GL, HL, CL, GR, HR, CR) = out
+        off = jax.lax.axis_index(DATA_AXIS) * F_loc
+        fid_global = (off + flat // B).astype(jnp.int32)
+        slot_r = (flat % B).astype(jnp.int32)
+        best, payload = pargmax_tuple(
+            chg, (fid_global, slot_r, slotl, GL, HL, CL, GR, HR, CR)
+        )
+        return (best,) + payload
+
+    specs_in = (
+        P(DATA_AXIS, None),  # bins_local
+        P(),  # pos (replicated; all_gather on entry if row-sharded)
+        P(),  # g
+        P(),  # h
+        P(DATA_AXIS),  # feat_mask
+    )
+    return jax.jit(
+        jax.shard_map(
+            step,
+            mesh=mesh,
+            in_specs=specs_in,
+            out_specs=tuple([P()] * 10),
+            check_vma=False,
+        )
+    )
+
+
+def _make_router(mesh, F_pad: int, n_nodes: int):
+    """Share each splitting node's feature row across the mesh (the owner
+    contributes, psum broadcasts — reference position allgather :443) and
+    route samples to next-level-local child slots."""
+    D = mesh.devices.size
+    F_loc = F_pad // D
+
+    def route(bins_local, pos, node_feat, node_slot, node_child_base):
+        off = jax.lax.axis_index(DATA_AXIS) * F_loc
+        fl = node_feat - off
+        mine = (node_feat >= 0) & (fl >= 0) & (fl < F_loc)
+        safe = jnp.maximum(pos, 0)
+        # each sample needs ONE bin: its node's split feature, contributed by
+        # the shard owning that feature — a per-sample (n,) psum, never the
+        # (N, n) row matrix (5 GB at Higgs level widths)
+        r = jnp.clip(fl[safe], 0, F_loc - 1)  # (n,) local row per sample
+        b_local = jnp.take_along_axis(bins_local, r[None, :], axis=0)[0]
+        b = jax.lax.psum(jnp.where(mine[safe], b_local, 0), DATA_AXIS)
+        base = node_child_base[safe]
+        go_right = b > node_slot[safe]
+        new = jnp.where(base >= 0, base + go_right.astype(jnp.int32), -1)
+        return jnp.where(pos >= 0, new, -1)
+
+    return jax.jit(
+        jax.shard_map(
+            route,
+            mesh=mesh,
+            in_specs=(P(DATA_AXIS, None), P(), P(), P(), P()),
+            out_specs=P(),
+            check_vma=False,
+        )
+    )
+
+
+def build_tree_level_feature_parallel(
+    trainer,
+    mesh,
+    bins_t,
+    F_pad: int,
+    g,
+    h,
+    pos0,
+    F: int,
+    B: int,
+    feat_mask,
+    names,
+) -> Tree:
+    """Level-synchronous exact-greedy growth with feature-sharded search.
+
+    Mirrors GBDTTrainer.build_tree_level_wise's host loop; only the
+    histogram/split/route kernels differ (sharded + merged)."""
+    p = trainer.params
+    tree = Tree()
+    pos = pos0
+    level_nids = [0]
+    fmask_pad = jnp.concatenate(
+        [jnp.asarray(feat_mask), jnp.zeros((F_pad - F,), bool)]
+    )
+
+    lr = np.float32(p.learning_rate)
+    max_leaves = p.max_leaf_cnt if p.max_leaf_cnt > 0 else 1 << 30
+    max_depth = p.max_depth if p.max_depth > 0 else 1 << 30
+
+    for depth in range(max_depth):
+        n_nodes = len(level_nids)
+        if n_nodes == 0:
+            break
+        n_pad = 1 << (n_nodes - 1).bit_length()
+        step = _cached(
+            "step",
+            (mesh, F_pad, B, trainer._cfg(), n_pad),
+            lambda: _make_level_step(mesh, F_pad, B, trainer._cfg(), n_pad),
+        )
+        out = tuple(np.asarray(o) for o in step(bins_t, pos, g, h, fmask_pad))
+        (chg, fid, slot_r, slot_l, GL, HL, CL, GR, HR, CR) = out
+
+        if depth == 0:
+            # root stats ride the first level pass (GL+GR = node totals even
+            # when no valid split exists: flat argmax over all -inf picks
+            # slot 0 where the exclusive left cumsum is 0)
+            Gt, Ht, Ct = GL[0] + GR[0], HL[0] + HR[0], CL[0] + CR[0]
+            tree.hess_sum[0], tree.sample_cnt[0] = float(Ht), int(round(Ct))
+            tree.leaf_value[0] = float(
+                np.float32(trainer.node_value_fn(Gt, Ht)) * lr
+            )
+
+        node_feat = np.full((n_pad,), -1, np.int32)
+        node_slot = np.full((n_pad,), 0, np.int32)
+        child_base = np.full((n_pad,), -1, np.int32)
+        next_nids: List[int] = []
+        leaves_after = tree.leaf_cnt()
+        for k in range(n_nodes):
+            nid = level_nids[k]
+            can = (
+                depth < max_depth
+                and leaves_after + 1 < max_leaves + 1
+                and trainer._decide_split(chg[k], CL[k], CR[k], HL[k], HR[k])
+            )
+            if not can:
+                continue
+            left, right = trainer._finish_split(
+                tree,
+                names,
+                nid,
+                int(fid[k]),
+                int(slot_l[k]),
+                int(slot_r[k]),
+                (GL[k], HL[k], CL[k], GR[k], HR[k], CR[k]),
+            )
+            tree.gain[nid] = float(chg[k])
+            tree.slot[nid] = int(slot_l[k])
+            tree.split[nid] = float(slot_r[k])
+            node_feat[k] = int(fid[k])
+            node_slot[k] = int(slot_l[k])
+            child_base[k] = len(next_nids)
+            next_nids.extend([left, right])
+            leaves_after = tree.leaf_cnt()
+        if not next_nids:
+            break
+        router = _cached(
+            "route",
+            (mesh, F_pad, n_pad),
+            lambda: _make_router(mesh, F_pad, n_pad),
+        )
+        pos = router(
+            bins_t,
+            pos,
+            jnp.asarray(node_feat),
+            jnp.asarray(node_slot),
+            jnp.asarray(child_base),
+        )
+        level_nids = next_nids
+
+    return tree

@@ -37,6 +37,9 @@ import jax.numpy as jnp
 import numpy as np
 
 
+BM_DEFAULT = 8192  # sample-block width: the Pallas grid's lane-major tile
+
+
 def _pad_to(x: int, m: int) -> int:
     return (x + m - 1) // m * m
 
@@ -134,7 +137,7 @@ def hist_wave(
     h,
     node_ids,
     B: int,
-    bm: int = 8192,
+    bm: int = BM_DEFAULT,
     use_bf16: bool = True,
     force_dense: bool = False,
 ):
@@ -159,7 +162,7 @@ def hist_wave(
     return jnp.transpose(out, (2, 0, 3, 1))
 
 
-def pad_inputs(bins: np.ndarray, bm: int = 8192):
+def pad_inputs(bins: np.ndarray, bm: int = BM_DEFAULT):
     """Host-side one-time prep: transpose + pad the bin matrix for hist_wave.
 
     Returns (bins_t (F, n_pad) int32, n_pad). Padding rows get bin 0 but

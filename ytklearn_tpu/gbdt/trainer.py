@@ -47,10 +47,16 @@ from ..eval import EvalSet
 from ..io.fs import FileSystem, LocalFileSystem
 from ..losses import create_loss
 from ..parallel.mesh import row_sharding
-from .binning import FeatureBins, bin_matrix, build_bins
+from .binning import (
+    FeatureBins,
+    bin_matrix,
+    bin_matrix_device,
+    build_bins,
+    build_bins_maybe_device,
+)
 from .data import GBDTData, GBDTIngest
 from .engine import GrowSpec, make_gain_fns, make_grow_tree, split_kernel
-from .hist import pad_inputs
+from .hist import BM_DEFAULT, pad_inputs
 from .tree import GBDTModel, Tree
 
 log = logging.getLogger("ytklearn_tpu.gbdt")
@@ -145,8 +151,14 @@ class GBDTTrainer:
         self.gain_fn, self.node_value_fn = make_gain_fns(*cfg)
         self.K = params.num_tree_in_group
         if engine == "auto":
-            # LAD leaf refinement is host-side (TreeRefiner.java)
-            engine = "host" if (params.loss_function == "l1" and self.K == 1) else "device"
+            # LAD leaf refinement is host-side (TreeRefiner.java); the
+            # feature-parallel maker is a host-loop maker by design
+            engine = (
+                "host"
+                if (params.loss_function == "l1" and self.K == 1)
+                or params.tree_maker == "feature"
+                else "device"
+            )
         self.engine = engine
         self.wave = wave
         self.use_bf16_hist = use_bf16_hist
@@ -234,12 +246,30 @@ class GBDTTrainer:
         self._missing_fill = train.missing_fill
 
         log.info("building bins (%d features)...", F)
-        bins = build_bins(train.X, train.weight, p, train.feature_names)
+        # single-device: bin on the TPU (sort + rank-pick + compare-count);
+        # the host path costs ~4s/feature at 10M rows (reference load+
+        # preprocess budget: 35s, docs/gbdt_experiments.md)
+        use_dev_bin = self.mesh is None or self.mesh.devices.size == 1
+        if use_dev_bin:
+            X_t_dev = jnp.transpose(jax.device_put(train.X))  # (F, n) real rows
+            bins = build_bins_maybe_device(
+                train.X, X_t_dev, train.weight, p, train.feature_names
+            )
+        else:
+            X_t_dev = None
+            bins = build_bins(train.X, train.weight, p, train.feature_names)
         B_real = bins.max_bins
         B = max(8, 1 << (B_real - 1).bit_length())  # pad to pow2 for tiling
-        bins_np = bin_matrix(train.X, bins)
-        bins_t_np, n_pad = pad_inputs(bins_np)
-        bins_t = self._put_cols(bins_t_np)
+        if use_dev_bin:
+            n_rows = train.X.shape[0]
+            n_pad = -(-n_rows // BM_DEFAULT) * BM_DEFAULT
+            Xp = jnp.pad(X_t_dev, ((0, 0), (0, n_pad - n_rows)))
+            bins_t = bin_matrix_device(Xp, bins)
+            del X_t_dev, Xp
+        else:
+            bins_np = bin_matrix(train.X, bins)
+            bins_t_np, n_pad = pad_inputs(bins_np)
+            bins_t = self._put_cols(bins_t_np)
         y = self._put(_pad0(train.y, n_pad))
         weight = self._put(_pad0(train.weight, n_pad))
         real_mask = self._put(np.arange(n_pad) < train.X.shape[0])
@@ -275,9 +305,18 @@ class GBDTTrainer:
         scores_t = None
         y_t = w_t = None
         if test is not None:
-            bins_test_np = bin_matrix(test.X, bins)
-            bt_np, nt_pad = pad_inputs(bins_test_np)
-            aux_bins = (self._put_cols(bt_np),)
+            if use_dev_bin:
+                nt = test.X.shape[0]
+                nt_pad = -(-nt // BM_DEFAULT) * BM_DEFAULT
+                Xt_t = jnp.pad(
+                    jnp.transpose(jax.device_put(test.X)), ((0, 0), (0, nt_pad - nt))
+                )
+                aux_bins = (bin_matrix_device(Xt_t, bins),)
+                del Xt_t
+            else:
+                bins_test_np = bin_matrix(test.X, bins)
+                bt_np, nt_pad = pad_inputs(bins_test_np)
+                aux_bins = (self._put_cols(bt_np),)
             y_t = self._put(_pad0(test.y, nt_pad))
             w_t = self._put(_pad0(test.weight, nt_pad))
             if K > 1:
@@ -395,13 +434,16 @@ class GBDTTrainer:
 
         carry = (scores, scores_t, bufs, loss_buf, tloss_buf)
         sync_every = max(1, (p.round_num - start_round) // 20)
+        self.sync_log: List[Tuple[int, float]] = []  # (round, wall s) at syncs
         for rnd in range(start_round, p.round_num):
             carry = jit_round(
                 carry, jnp.asarray(rnd), jax.random.fold_in(root_key, rnd), data
             )
             if (rnd + 1) % sync_every == 0 or rnd == p.round_num - 1:
                 tl = float(carry[3][rnd])  # syncs the pipeline
-                msg = f"[round={rnd}] {time.time()-t0:.1f}s train loss={tl:.6f}"
+                elapsed = time.time() - t0
+                self.sync_log.append((rnd, elapsed))
+                msg = f"[round={rnd}] {elapsed:.1f}s train loss={tl:.6f}"
                 if has_test:
                     msg += f" test loss={float(carry[4][rnd]):.6f}"
                 log.info(msg)
@@ -729,7 +771,22 @@ class GBDTTrainer:
         log.info("building bins (%d features)...", F)
         bins = build_bins(train.X, train.weight, p, train.feature_names)
         B = bins.max_bins
-        bins_train = self._put(bin_matrix(train.X, bins))
+        bins_np = bin_matrix(train.X, bins)
+        bins_train = self._put(bins_np)
+
+        feature_parallel = p.tree_maker == "feature" and self.mesh is not None
+        if feature_parallel:
+            # columns sharded over the mesh (FeatureParallelTreeMakerByLevel);
+            # the maker is level-wise only, as in the reference
+            from .feature_parallel import shard_features
+
+            bins_t_fp, F_pad_fp = shard_features(self.mesh, bins_np)
+            if p.tree_grow_policy != "level":
+                log.info(
+                    "tree_maker=feature grows level-wise (reference maker is "
+                    "ByLevel); ignoring tree_grow_policy=%r", p.tree_grow_policy
+                )
+        del bins_np
         y = self._put(train.y)
         weight = self._put(train.weight)
         log.info(
@@ -811,7 +868,14 @@ class GBDTTrainer:
             for grp in range(K):
                 g = (gs[:, grp] if K > 1 else gs) * weight
                 h = (hs[:, grp] if K > 1 else hs) * weight
-                if p.tree_grow_policy == "loss":
+                if feature_parallel:
+                    from .feature_parallel import build_tree_level_feature_parallel
+
+                    tree = build_tree_level_feature_parallel(
+                        self, self.mesh, bins_t_fp, F_pad_fp, g, h, pos0,
+                        F, B, fmask_dev, feat_names,
+                    )
+                elif p.tree_grow_policy == "loss":
                     tree = self.build_tree_loss_wise(
                         bins_train, g, h, pos0, F, B, fmask_dev, feat_names
                     )

@@ -198,6 +198,12 @@ class HoagTrainer:
                 raise ValueError(
                     f"hyper.hoag.outer_iter must be > 0, got {p.hyper.hoag_outer_iter}"
                 )
+            if not np.any(hoag_l2 > 0.0):
+                raise ValueError(
+                    "hyper.mode=hoag needs at least one positive hyper.hoag.l2 "
+                    "entry (the hypergradient steps log(l2); l2=0 blocks are "
+                    "held fixed)"
+                )
             rounds = [(hoag_l1, hoag_l2)] * p.hyper.hoag_outer_iter
             hoag_steps = np.full((n_blocks,), p.hyper.hoag_init_step)
             hoag_grad_hist: List[np.ndarray] = []
