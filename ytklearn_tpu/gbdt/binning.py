@@ -148,14 +148,130 @@ def build_bins(
         if len(vals) == 0:
             vals = np.zeros((1,), np.float32)
         per_feature.append(np.sort(vals))
+    return _to_feature_bins(per_feature)
+
+
+def _to_feature_bins(per_feature: List[np.ndarray]) -> "FeatureBins":
+    """Pad per-feature sorted candidate lists to a common width (padding
+    repeats the last value so searchsorted stays monotone)."""
     max_bins = max(len(v) for v in per_feature)
+    F = len(per_feature)
     values = np.empty((F, max_bins), np.float32)
     counts = np.empty((F,), np.int32)
     for f, v in enumerate(per_feature):
         values[f, : len(v)] = v
-        values[f, len(v):] = v[-1]  # pad with last value (monotone)
+        values[f, len(v):] = v[-1]
         counts[f] = len(v)
     return FeatureBins(values=values, counts=counts, max_bins=max_bins)
+
+
+def merge_quantile_candidates(
+    values_list: List[np.ndarray], mass_list: List[float], max_cnt: int
+) -> np.ndarray:
+    """Merge per-process quantile candidate sets into global candidates.
+
+    Each process's candidates are (approximately) equal-mass quantile points
+    of its local distribution, so the merged multiset with per-point mass
+    total_i/len(values_i) is a compressed sketch of the global distribution;
+    querying max_cnt even ranks of it is the TPU-host equivalent of the
+    reference's GK summary merge + query (SampleManager.java:129-143,
+    WeightApproximateQuantile.merge:476)."""
+    vals = np.concatenate([np.asarray(v, np.float64) for v in values_list])
+    mass = np.concatenate(
+        [
+            np.full(len(v), m / max(len(v), 1), np.float64)
+            for v, m in zip(values_list, mass_list)
+        ]
+    )
+    order = np.argsort(vals, kind="stable")
+    sv, sm = vals[order], mass[order]
+    cw = np.cumsum(sm)
+    total = cw[-1]
+    # midpoint rule: candidate k summarizes the local mass interval ending at
+    # it, so its representative rank is the interval's center — without the
+    # -mass/2 shift every merged quantile reads ~half a rank high
+    ranks = (np.arange(1, max_cnt + 1) / max_cnt) * total
+    pos = np.searchsorted(cw - 0.5 * sm, ranks, side="left").clip(0, len(sv) - 1)
+    return np.unique(sv[pos].astype(np.float32))
+
+
+def merge_bins_multihost(
+    local: "FeatureBins",
+    local_exact: np.ndarray,
+    local_mass: np.ndarray,
+    max_cnt_arr: np.ndarray,
+    discrete: np.ndarray,
+) -> "FeatureBins":
+    """Cross-process merge of per-feature bin candidates.
+
+    discrete[f]: non-quantile sampler — merges by uncapped set union (the
+    allreduceMapSetUnion path of SampleManager.java:128; no_sample keeps
+    exact-greedy semantics across hosts). Quantile features stay exact as a
+    union while every process kept all distinct values AND the union fits
+    that feature's max_cnt; otherwise the weighted-sketch merge applies."""
+    from ..parallel.collectives import host_allgather_objects
+
+    payload = (
+        [local.values[f, : local.counts[f]] for f in range(len(local.counts))],
+        local_exact,
+        local_mass,
+    )
+    gathered = host_allgather_objects(payload)
+    if len(gathered) == 1:
+        return local
+    F = len(local.counts)
+    per_feature: List[np.ndarray] = []
+    for f in range(F):
+        sets = [g[0][f] for g in gathered]
+        exacts = [bool(g[1][f]) for g in gathered]
+        masses = [float(g[2][f]) for g in gathered]
+        union = np.unique(np.concatenate(sets))
+        if discrete[f] or (all(exacts) and len(union) <= int(max_cnt_arr[f])):
+            per_feature.append(union.astype(np.float32))
+        else:
+            per_feature.append(
+                merge_quantile_candidates(sets, masses, int(max_cnt_arr[f]))
+            )
+    return _to_feature_bins(per_feature)
+
+
+def build_bins_global(
+    X: np.ndarray,
+    weight: np.ndarray,
+    params: GBDTParams,
+    feature_names: Optional[Sequence[str]] = None,
+    seed: int = 20170425,
+) -> FeatureBins:
+    """build_bins + multi-host candidate merge (no-op single-process)."""
+    import jax
+
+    local = build_bins(X, weight, params, feature_names, seed)
+    if jax.process_count() == 1:
+        return local
+    F = X.shape[1]
+    names = feature_names or [str(i) for i in range(F)]
+    exact = np.zeros((F,), bool)
+    discrete = np.zeros((F,), bool)
+    mass = np.zeros((F,), np.float64)
+    max_cnt_arr = np.zeros((F,), np.int64)
+    for f in range(F):
+        spec = _spec_for(f, names[f], params.approximate)
+        max_cnt_arr[f] = spec.max_cnt
+        if spec.type == "sample_by_quantile":
+            # exact iff the sampler took the all-distinct path (candidate
+            # count alone misclassifies deduplicated rank picks)
+            exact[f] = len(np.unique(X[:, f])) <= spec.max_cnt
+            w = (
+                np.power(np.maximum(weight, 0.0), spec.alpha)
+                if spec.use_sample_weight
+                else np.ones_like(weight)
+            )
+            mass[f] = float(np.sum(w))
+        else:
+            discrete[f] = True  # discrete samplers merge by set union
+            exact[f] = True
+            mass[f] = float(len(X))
+    return merge_bins_multihost(local, exact, mass, max_cnt_arr, discrete)
 
 
 def quantile_bins_device(
@@ -254,14 +370,7 @@ def build_bins_maybe_device(
         if len(vals) == 0:
             vals = np.zeros((1,), np.float32)
         per_feature.append(np.sort(vals).astype(np.float32))
-    max_bins = max(len(v) for v in per_feature)
-    values = np.empty((F, max_bins), np.float32)
-    counts = np.empty((F,), np.int32)
-    for f, v in enumerate(per_feature):
-        values[f, : len(v)] = v
-        values[f, len(v):] = v[-1]
-        counts[f] = len(v)
-    return FeatureBins(values=values, counts=counts, max_bins=max_bins)
+    return _to_feature_bins(per_feature)
 
 
 def bin_matrix_device(X_t_dev, bins: FeatureBins):

@@ -75,9 +75,23 @@ class GBDTIngest:
         self.K = params.class_num if params.loss_function == "softmax" else 1
 
     def _lines(self, paths):
-        """Raw lines, optionally expanded through the python transform hook
-        (reference: Jython transform, dataflow/CoreData.java:298-311)."""
-        for raw in self.fs.read_lines(paths):
+        """Raw lines for THIS process's shard, optionally expanded through
+        the python transform hook (reference: Jython transform,
+        dataflow/CoreData.java:298-311; sharding: DataFlow.java:391-410
+        lines_avg / files_avg, mirroring io.reader.DataIngest.load)."""
+        import jax
+
+        p = self.params
+        n_proc = jax.process_count()
+        proc = jax.process_index()
+        if p.data.assigned or n_proc == 1:
+            it = self.fs.read_lines(paths)
+        elif p.data.unassigned_mode == "files_avg":
+            files = sorted(self.fs.recur_get_paths(paths))
+            it = self.fs.read_lines(files[proc::n_proc])
+        else:
+            it = self.fs.select_read_lines(paths, n_proc, proc)
+        for raw in it:
             if self.transform_hook is None:
                 yield raw
             else:
@@ -159,9 +173,12 @@ class GBDTIngest:
         return GBDTData(X=X, y=y, weight=weight, n_real=n, feature_names=names)
 
     def compute_missing_fill(self, X: np.ndarray) -> np.ndarray:
-        """(F,) fill values per the configured strategy
-        (reference: ComputeMean.java:71, ComputeQuantile.java:72,
-        ComputeValue — `mean` | `quantile@q` | `value@v`)."""
+        """(F,) fill values per the configured strategy, globally merged
+        across processes (reference: ComputeMean.java:71 allreduce,
+        ComputeQuantile.java:72 sketch allreduce, ComputeValue —
+        `mean` | `quantile@q` | `value@v`)."""
+        from ..parallel.collectives import host_allgather_objects
+
         spec = self.params.missing_value
         base, _, arg = str(spec).partition("@")
         base = base.lower()
@@ -169,19 +186,91 @@ class GBDTIngest:
             v = float(arg) if arg else 0.0
             return np.full((X.shape[1],), v, np.float32)
         if base == "mean":
-            with np.errstate(invalid="ignore"):
-                fill = np.nanmean(X, axis=0)
-            return np.nan_to_num(fill, nan=0.0).astype(np.float32)
+            # exact across processes: allreduce of (nansum, non-nan count)
+            sums = np.nansum(X, axis=0, dtype=np.float64)
+            cnts = np.sum(~np.isnan(X), axis=0, dtype=np.int64)
+            merged = host_allgather_objects((sums, cnts))
+            tot = np.sum([m[0] for m in merged], axis=0)
+            cnt = np.sum([m[1] for m in merged], axis=0)
+            with np.errstate(invalid="ignore", divide="ignore"):
+                fill = np.where(cnt > 0, tot / np.maximum(cnt, 1), 0.0)
+            return fill.astype(np.float32)
         if base == "quantile":
+            import jax
+
             q = float(arg) if arg else 0.5
+            if jax.process_count() == 1:
+                with np.errstate(invalid="ignore", all="ignore"):
+                    fill = np.nanquantile(X, q, axis=0)
+                return np.nan_to_num(fill, nan=0.0).astype(np.float32)
+            # local per-feature quantile grids merge as weighted sketches
+            # (approximate, like the reference's GK summaries)
+            from .binning import merge_quantile_candidates
+
+            grid = np.linspace(0.0, 1.0, 257)
             with np.errstate(invalid="ignore", all="ignore"):
-                fill = np.nanquantile(X, q, axis=0)
-            return np.nan_to_num(fill, nan=0.0).astype(np.float32)
+                local = np.nanquantile(X, grid, axis=0)  # (257, F)
+            cnts = np.sum(~np.isnan(X), axis=0, dtype=np.int64)
+            merged = host_allgather_objects((local, cnts))
+            F = X.shape[1]
+            fill = np.zeros((F,), np.float32)
+            for f in range(F):
+                pairs = []
+                for m in merged:
+                    vals = m[0][:, f]
+                    vals = vals[~np.isnan(vals)]
+                    mass = float(m[1][f])
+                    if len(vals) and mass > 0:
+                        pairs.append((vals, mass))
+                if not pairs:
+                    continue
+                cand = merge_quantile_candidates(
+                    [p[0] for p in pairs], [p[1] for p in pairs], 257
+                )
+                fill[f] = cand[min(int(q * (len(cand) - 1) + 0.5), len(cand) - 1)]
+            return fill
         raise ValueError(f"unknown missing_value strategy: {spec!r}")
 
+    def _merge_fmap_multihost(self, train: GBDTData) -> GBDTData:
+        """Reconcile per-process first-seen feature dicts into one global
+        name->column map and remap the local matrix (reference:
+        DataFlow.handleLocalIdx:413-446 local->global index rewrite)."""
+        from ..parallel.collectives import host_allgather_objects
+
+        gathered = host_allgather_objects(sorted(self._fmap))
+        if len(gathered) == 1:
+            return train
+        names = sorted(set().union(*[set(g) for g in gathered]))
+        if len(names) > self.F:
+            raise ValueError(
+                f"max_feature_dim({self.F}) smaller than global feature "
+                f"number {len(names)}"
+            )
+        gmap = {n: i for i, n in enumerate(names)}
+        X = np.full_like(train.X, np.nan)
+        for name, old in self._fmap.items():
+            X[:, gmap[name]] = train.X[:, old]
+        self._fmap = gmap
+        new_names = [str(i) for i in range(self.F)]
+        for n, i in gmap.items():
+            new_names[i] = n
+        return GBDTData(
+            X=X, y=train.y, weight=train.weight, n_real=train.n_real,
+            feature_names=new_names,
+        )
+
     def load(self) -> Tuple[GBDTData, Optional[GBDTData]]:
+        import jax
+
         p = self.params
         train = self._parse(p.data.train_paths, p.data.train_max_error_tol)
+        if train.n_real == 0:
+            raise ValueError(
+                f"process {jax.process_index()} got an empty training shard "
+                f"({p.data.unassigned_mode} over {len(p.data.train_paths)} "
+                "path(s)) — use lines_avg sharding or fewer processes"
+            )
+        train = self._merge_fmap_multihost(train)
         fill = self.compute_missing_fill(train.X)
         train.missing_fill = fill
         _apply_fill(train.X, fill)

@@ -51,7 +51,7 @@ from .binning import (
     FeatureBins,
     bin_matrix,
     bin_matrix_device,
-    build_bins,
+    build_bins_global,
     build_bins_maybe_device,
 )
 from .data import GBDTData, GBDTIngest
@@ -249,7 +249,9 @@ class GBDTTrainer:
         # single-device: bin on the TPU (sort + rank-pick + compare-count);
         # the host path costs ~4s/feature at 10M rows (reference load+
         # preprocess budget: 35s, docs/gbdt_experiments.md)
-        use_dev_bin = self.mesh is None or self.mesh.devices.size == 1
+        use_dev_bin = (
+            self.mesh is None or self.mesh.devices.size == 1
+        ) and jax.process_count() == 1
         if use_dev_bin:
             X_t_dev = jnp.transpose(jax.device_put(train.X))  # (F, n) real rows
             bins = build_bins_maybe_device(
@@ -257,7 +259,7 @@ class GBDTTrainer:
             )
         else:
             X_t_dev = None
-            bins = build_bins(train.X, train.weight, p, train.feature_names)
+            bins = build_bins_global(train.X, train.weight, p, train.feature_names)
         B_real = bins.max_bins
         B = max(8, 1 << (B_real - 1).bit_length())  # pad to pow2 for tiling
         if use_dev_bin:
@@ -464,6 +466,24 @@ class GBDTTrainer:
     def _base_score(self, train: GBDTData, K: int):
         p = self.params
         if p.sample_dependent_base_prediction:
+            if jax.process_count() > 1:
+                # global weighted label mean across process shards
+                from ..parallel.collectives import host_allgather_objects
+
+                w = train.weight[: train.n_real]
+                y = np.asarray(train.y[: train.n_real])
+                wy = (
+                    (w[:, None] * y).sum(axis=0) if K > 1 else float(np.dot(w, y))
+                )
+                merged = host_allgather_objects((wy, float(np.sum(w))))
+                tot_wy = np.sum([m[0] for m in merged], axis=0)
+                tot_w = float(np.sum([m[1] for m in merged]))
+                mean = tot_wy / max(tot_w, 1e-12)
+                if K > 1:
+                    return np.asarray(
+                        self.loss.pred2score(jnp.asarray(mean)), np.float32
+                    )
+                return np.float32(self.loss.pred2score(float(mean)))
             if K > 1:
                 mean = np.average(
                     np.asarray(train.y[: train.n_real]),
@@ -769,7 +789,7 @@ class GBDTTrainer:
 
         self._missing_fill = train.missing_fill
         log.info("building bins (%d features)...", F)
-        bins = build_bins(train.X, train.weight, p, train.feature_names)
+        bins = build_bins_global(train.X, train.weight, p, train.feature_names)
         B = bins.max_bins
         bins_np = bin_matrix(train.X, bins)
         bins_train = self._put(bins_np)

@@ -106,14 +106,31 @@ def axis_size(axis_name: str = DATA_AXIS):
 
 
 def host_allgather_objects(obj):
-    """Gather a small python object from every process (multi-host only).
+    """Gather a small python object from every process; returns a list with
+    one entry per process, in rank order (multi-host only — single-process
+    returns [obj]).
 
-    Single-process returns [obj]. The multi-host path uses
-    jax.experimental.multihost_utils over DCN — acceptable because these
-    merges happen once at load time (the reference likewise routed them
-    through the master's TCP link, not the hot path)."""
+    multihost_utils.process_allgather stacks ARRAY leaves along a leading
+    axis and cannot carry strings or ragged structures, so the object is
+    pickled into a padded uint8 buffer first (two rounds: lengths, then
+    bytes) — the Kryo-over-TCP objects of the reference's allreduceMap,
+    done over DCN. Load-time only; never the hot path."""
     if jax.process_count() == 1:
         return [obj]
+    import pickle
+
+    import numpy as np
     from jax.experimental import multihost_utils
 
-    return multihost_utils.process_allgather(obj, tiled=False)
+    blob = np.frombuffer(pickle.dumps(obj), np.uint8)
+    lens = np.asarray(
+        multihost_utils.process_allgather(np.asarray([blob.size], np.int64))
+    ).reshape(-1)
+    padded = np.zeros((int(lens.max()),), np.uint8)
+    padded[: blob.size] = blob
+    allb = np.asarray(multihost_utils.process_allgather(padded)).reshape(
+        len(lens), -1
+    )
+    return [
+        pickle.loads(allb[i, : int(lens[i])].tobytes()) for i in range(len(lens))
+    ]
