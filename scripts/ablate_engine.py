@@ -1,0 +1,78 @@
+"""Cost-decomposition ablations for the device GBDT engine at Higgs scale.
+
+Generates data ON DEVICE (no tunnel transfer), trains a few trees per
+config, reports the steady trees/s from trainer.time_stats.
+
+Usage: python scripts/ablate_engine.py [n_rows] [config ...]
+  configs: b256 (default), b64 (4x fewer hist FLOPs), notest, wave32
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+logging.basicConfig(level=logging.INFO, stream=sys.stdout)
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from ytklearn_tpu.config.params import ApproximateSpec, GBDTParams, ModelParams
+    from ytklearn_tpu.gbdt.data import GBDTData
+    from ytklearn_tpu.gbdt.trainer import GBDTTrainer
+
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 10_500_000
+    configs = sys.argv[2:] or ["b256"]
+    F = 28
+
+    key = jax.random.PRNGKey(0)
+    kx, ke = jax.random.split(key)
+    X = jax.random.normal(kx, (n, F), jnp.float32)
+    logit = (
+        1.5 * X[:, 0] * X[:, 1]
+        + jnp.sin(X[:, 2] * 2)
+        + 0.8 * (X[:, 3] > 0.5)
+        - 0.5 * X[:, 4] ** 2
+    )
+    y = (logit + jax.random.normal(ke, (n,)) * 0.5 > 0).astype(jnp.float32)
+    y.block_until_ready()
+    train = GBDTData(
+        X=X, y=y, weight=np.ones(n, np.float32), n_real=n,
+        feature_names=[f"f{i}" for i in range(F)],
+    )
+
+    for cfg in configs:
+        max_cnt = 63 if cfg == "b64" else 255
+        wave = {"wave32": 32, "wave42": 42, "wave64": 64}.get(cfg, 16)
+        params = GBDTParams(
+            round_num=10,
+            max_depth=60,
+            max_leaf_cnt=255,
+            tree_grow_policy="loss",
+            learning_rate=0.1,
+            min_child_hessian_sum=100.0,
+            loss_function="sigmoid",
+            eval_metric=[],
+            approximate=[ApproximateSpec(type="sample_by_quantile", max_cnt=max_cnt)],
+            model=ModelParams(data_path="/tmp/ablate_model", dump_freq=0),
+        )
+        t0 = time.time()
+        tr = GBDTTrainer(params, engine="device", wave=wave)
+        tr.train(train=train)
+        print(
+            f"CONFIG {cfg}: steady={tr.time_stats.get('trees_per_sec_steady', 0):.3f}"
+            f" trees/s  stats={ {k: round(v,1) for k,v in tr.time_stats.items()} }",
+            flush=True,
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -24,6 +24,7 @@ def main() -> None:
     wave = int(sys.argv[3]) if len(sys.argv) > 3 else 16
     policy = sys.argv[4] if len(sys.argv) > 4 else "loss"
     leaves = int(sys.argv[5]) if len(sys.argv) > 5 else 255
+    prec = sys.argv[6] if len(sys.argv) > 6 else "bf16"
 
     from ytklearn_tpu.config.params import ApproximateSpec, GBDTParams, ModelParams
     from ytklearn_tpu.gbdt.data import GBDTData
@@ -64,12 +65,12 @@ def main() -> None:
         model=ModelParams(data_path="/tmp/profile_engine_model", dump_freq=0),
     )
     t0 = time.time()
-    trainer = GBDTTrainer(params, engine="device", wave=wave)
+    trainer = GBDTTrainer(params, engine="device", wave=wave, hist_precision=prec)
     res = trainer.train(train=train, test=test)
     dt = time.time() - t0
     nb = len(res.model.trees)
     print(
-        f"policy={policy} wave={wave} rows={n} trees={nb} total={dt:.1f}s "
+        f"policy={policy} wave={wave} prec={prec} rows={n} trees={nb} total={dt:.1f}s "
         f"trees/s={nb/dt:.3f} train_loss={res.train_loss:.5f} "
         f"test_loss={res.test_loss:.5f} test_auc={res.test_metrics.get('auc'):.5f}"
     )

@@ -131,3 +131,42 @@ def test_engine_multiclass_softmax(tmp_path):
     res = GBDTTrainer(p, engine="device").train(train=data)
     assert len(res.model.trees) == 3 * K
     assert res.train_metrics["confusion_matrix"] > 0.8
+
+
+def test_int8_hist_exact_on_integer_grads():
+    """With integer-valued g/h at max-abs 127 the int8 quantization is
+    lossless, so hist_wave_q must equal hist_wave exactly."""
+    import jax.numpy as jnp
+
+    from ytklearn_tpu.gbdt.hist import hist_wave, hist_wave_q
+
+    rng = np.random.RandomState(0)
+    n, F, B = 8192, 4, 16
+    bins_t = jnp.asarray(rng.randint(0, B, size=(F, n)).astype(np.int32))
+    g_int = rng.randint(-127, 128, n).astype(np.float32)
+    h_int = rng.randint(0, 128, n).astype(np.float32)
+    pos = jnp.asarray(rng.randint(-1, 3, n).astype(np.int32))
+    ids = jnp.asarray(np.arange(3, dtype=np.int32))
+
+    ref = np.asarray(
+        hist_wave(bins_t, pos, jnp.asarray(g_int), jnp.asarray(h_int), ids, B,
+                  use_bf16=False, force_dense=True)
+    )
+    got = np.asarray(
+        hist_wave_q(
+            bins_t, pos,
+            jnp.asarray(g_int), jnp.asarray(h_int),
+            ids, B, force_dense=True,
+        )
+    ).astype(np.float32)
+    np.testing.assert_array_equal(ref, got)
+
+
+def test_int8_engine_quality_close_to_bf16(tmp_path):
+    """int8-quantized histograms must not visibly hurt model quality."""
+    data = _data(n=4000)
+    p = _params(tmp_path, "loss", round_num=6, max_leaf_cnt=24)
+    res_ref = GBDTTrainer(p, engine="device", hist_precision="f32").train(train=data)
+    res_q = GBDTTrainer(p, engine="device", hist_precision="int8").train(train=data)
+    assert abs(res_q.train_metrics["auc"] - res_ref.train_metrics["auc"]) < 0.01
+    assert res_q.train_loss == pytest.approx(res_ref.train_loss, rel=0.05)

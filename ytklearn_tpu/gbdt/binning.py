@@ -46,6 +46,9 @@ class FeatureBins:
     values: np.ndarray  # (F, B) f32 sorted per row
     counts: np.ndarray  # (F,) int32
     max_bins: int
+    # exact[f]: the sampler kept every distinct value (all-distinct path);
+    # None when unknown (device-built bins don't track it)
+    exact: Optional[np.ndarray] = None
 
     def split_value(self, fid: int, slot: int, split_type: str = "mean") -> float:
         """Split cond for 'bins <= slot go left' (reference:
@@ -63,23 +66,24 @@ class FeatureBins:
 
 def _sample_feature(
     col: np.ndarray, weight: np.ndarray, spec: ApproximateSpec, rng: np.random.RandomState
-) -> np.ndarray:
+) -> Tuple[np.ndarray, bool]:
+    """-> (sorted candidate values, kept-all-distinct flag)."""
     kind = spec.type
     if kind == "no_sample":
-        return np.unique(col)
+        return np.unique(col), True
     if kind == "sample_by_cnt":
         vals = np.unique(col)
         if len(vals) > spec.max_cnt:
             picks = rng.choice(len(col), size=spec.max_cnt, replace=False)
-            vals = np.unique(col[picks])
-        return vals
+            return np.unique(col[picks]), False
+        return vals, True
     if kind == "sample_by_rate":
         vals = np.unique(col)
         if len(vals) > spec.min_cnt:
             mask = rng.rand(len(col)) <= spec.sample_rate
             if mask.any():
-                vals = np.unique(col[mask])
-        return vals
+                return np.unique(col[mask]), False
+        return vals, True
     if kind == "sample_by_precision":
         x = col.astype(np.float64)
         lo = hi = None
@@ -94,11 +98,11 @@ def _sample_feature(
             r = np.sign(r) * (np.expm1(np.abs(r)))
         if spec.use_min_max and lo is not None and hi > lo:
             r = r * (hi - lo) + lo
-        return np.unique(r.astype(np.float32))
+        return np.unique(r.astype(np.float32)), False
     if kind == "sample_by_quantile":
         vals = np.unique(col)
         if len(vals) <= spec.max_cnt:
-            return vals
+            return vals, True
         w = (
             np.power(np.maximum(weight, 0.0), spec.alpha)
             if spec.use_sample_weight
@@ -111,7 +115,7 @@ def _sample_feature(
         # max_cnt evenly spaced quantile ranks (the GK query points)
         ranks = (np.arange(1, spec.max_cnt + 1) / spec.max_cnt) * total
         pos = np.searchsorted(cw, ranks, side="left").clip(0, len(sv) - 1)
-        return np.unique(sv[pos])
+        return np.unique(sv[pos]), False
     raise ValueError(f"unknown sampler type: {kind!r}")
 
 
@@ -142,13 +146,17 @@ def build_bins(
     F = X.shape[1]
     names = feature_names or [str(i) for i in range(F)]
     per_feature: List[np.ndarray] = []
+    exact = np.zeros((F,), bool)
     for f in range(F):
         spec = _spec_for(f, names[f], params.approximate)
-        vals = _sample_feature(X[:, f], weight, spec, rng).astype(np.float32)
+        vals, exact[f] = _sample_feature(X[:, f], weight, spec, rng)
+        vals = vals.astype(np.float32)
         if len(vals) == 0:
             vals = np.zeros((1,), np.float32)
         per_feature.append(np.sort(vals))
-    return _to_feature_bins(per_feature)
+    out = _to_feature_bins(per_feature)
+    out.exact = exact
+    return out
 
 
 def _to_feature_bins(per_feature: List[np.ndarray]) -> "FeatureBins":
@@ -258,9 +266,9 @@ def build_bins_global(
         spec = _spec_for(f, names[f], params.approximate)
         max_cnt_arr[f] = spec.max_cnt
         if spec.type == "sample_by_quantile":
-            # exact iff the sampler took the all-distinct path (candidate
-            # count alone misclassifies deduplicated rank picks)
-            exact[f] = len(np.unique(X[:, f])) <= spec.max_cnt
+            # exact iff the sampler took the all-distinct path (tracked by
+            # build_bins; candidate count alone misclassifies dedup'd picks)
+            exact[f] = bool(local.exact[f]) if local.exact is not None else False
             w = (
                 np.power(np.maximum(weight, 0.0), spec.alpha)
                 if spec.use_sample_weight

@@ -79,19 +79,9 @@ class GBDTIngest:
         the python transform hook (reference: Jython transform,
         dataflow/CoreData.java:298-311; sharding: DataFlow.java:391-410
         lines_avg / files_avg, mirroring io.reader.DataIngest.load)."""
-        import jax
+        from ..io.reader import shard_read_lines
 
-        p = self.params
-        n_proc = jax.process_count()
-        proc = jax.process_index()
-        if p.data.assigned or n_proc == 1:
-            it = self.fs.read_lines(paths)
-        elif p.data.unassigned_mode == "files_avg":
-            files = sorted(self.fs.recur_get_paths(paths))
-            it = self.fs.read_lines(files[proc::n_proc])
-        else:
-            it = self.fs.select_read_lines(paths, n_proc, proc)
-        for raw in it:
+        for raw in shard_read_lines(self.fs, self.params.data, paths):
             if self.transform_hook is None:
                 yield raw
             else:
@@ -264,11 +254,17 @@ class GBDTIngest:
 
         p = self.params
         train = self._parse(p.data.train_paths, p.data.train_max_error_tol)
-        if train.n_real == 0:
+        # raise on ALL ranks (a single-rank raise would leave the peers
+        # blocked inside the next allgather collective)
+        from ..parallel.collectives import host_allgather_objects
+
+        counts = host_allgather_objects(train.n_real)
+        if min(counts) == 0:
             raise ValueError(
-                f"process {jax.process_index()} got an empty training shard "
-                f"({p.data.unassigned_mode} over {len(p.data.train_paths)} "
-                "path(s)) — use lines_avg sharding or fewer processes"
+                f"process(es) {[i for i, c in enumerate(counts) if c == 0]} got "
+                f"an empty training shard ({p.data.unassigned_mode} over "
+                f"{len(p.data.train_paths)} path(s)) — use lines_avg sharding "
+                "or fewer processes"
             )
         train = self._merge_fmap_multihost(train)
         fill = self.compute_missing_fill(train.X)

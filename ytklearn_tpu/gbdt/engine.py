@@ -41,7 +41,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .hist import hist_wave
+from .hist import hist_wave, hist_wave_q
+from .route import route_wave
 
 BIG32 = np.int32(2**31 - 1)
 
@@ -164,6 +165,7 @@ class GrowSpec:
     bm: int = 8192
     use_bf16: bool = True
     force_dense: bool = False
+    hist_mode: str = "mxu"  # "mxu" (bf16/f32 per use_bf16) | "int8" 
 
     @property
     def depth_cap(self) -> int:
@@ -243,20 +245,61 @@ def make_grow_tree(spec: GrowSpec):
         # capacity: children must fit the fixed arrays
         return ok & (leaves < spec.leaf_cap)
 
-    def select(ok, fr: _Frontier, tr: TreeArrays):
+    def select(ok, fr: _Frontier, tr: TreeArrays, nw: int):
         if spec.policy == "level":
             k1 = jnp.where(ok, tr.depth, BIG32)
             _, sel = jax.lax.sort((k1, iota_m), num_keys=2)
         else:
             k1 = jnp.where(ok, -fr.chg, jnp.inf)
             _, sel = jax.lax.sort((k1, iota_m), num_keys=2)
-        sel = sel[:NW]
+        sel = sel[:nw]
         return sel, ok[sel]
 
     def grow(bins_t, include, g, h, feat_mask, aux=()):
         n = bins_t.shape[1]
         pos = jnp.zeros((n,), jnp.int32)
         aux_pos = tuple(jnp.zeros((bt.shape[1],), jnp.int32) for bt in aux)
+
+        # tile once per tree: the Pallas kernels want (F, nblk, 1, bm); done
+        # inside the wave loop XLA re-materializes the tiled copy EVERY wave
+        # (~10 ms x 20 waves per tree at 10M rows, seen in xprof)
+        if not spec.force_dense:
+            bins_k = bins_t.reshape(F, n // spec.bm, 1, spec.bm)
+            aux_k = tuple(
+                bt.reshape(F, bt.shape[1] // spec.bm, 1, spec.bm) for bt in aux
+            )
+        else:
+            bins_k = bins_t
+            aux_k = aux
+
+        if spec.hist_mode == "int8":
+            # per-tree symmetric int8 quantization of the (weighted) grads;
+            # one-hot selection and counts stay exact, G/H sums carry a
+            # bounded ~|g|max/(2*qmax)-per-sample rounding error in exchange
+            # for the int8 MXU path. qmax shrinks above ~16.9M rows so the
+            # worst-case i32 column accumulation (qmax * n) cannot overflow.
+            qmax = float(min(127, (2**31 - 1) // max(n, 1)))
+            sg = qmax / jnp.maximum(jnp.max(jnp.abs(g)), 1e-12)
+            sh = qmax / jnp.maximum(jnp.max(jnp.abs(h)), 1e-12)
+            gq = jnp.clip(jnp.round(g * sg), -qmax, qmax)  # f32 integers:
+            hq = jnp.clip(jnp.round(h * sh), -qmax, qmax)  # kernel casts to i8
+            inv = jnp.stack([1.0 / sg, 1.0 / sh, jnp.asarray(1.0)])
+
+            def hist_call(pos_fit, ids):
+                hq_i32 = hist_wave_q(
+                    bins_k, pos_fit, gq, hq, ids, B,
+                    bm=spec.bm, force_dense=spec.force_dense,
+                )  # (N, F, B, 3) i32
+                return hq_i32.astype(jnp.float32) * inv[None, None, None, :]
+
+        else:
+
+            def hist_call(pos_fit, ids):
+                return hist_wave(
+                    bins_k, pos_fit, g, h, ids, B,
+                    bm=spec.bm, use_bf16=spec.use_bf16,
+                    force_dense=spec.force_dense,
+                )
 
         tr = TreeArrays(
             feat=jnp.full((M,), -1, jnp.int32),
@@ -273,12 +316,9 @@ def make_grow_tree(spec: GrowSpec):
         )
 
         # root histogram + stats + frontier
-        ids0 = jnp.full((NW,), -2, jnp.int32).at[0].set(0)
+        ids0 = jnp.asarray([0], jnp.int32)  # root wave: one real slot
         pos_fit = jnp.where(include, pos, -1)
-        hist0 = hist_wave(
-            bins_t, pos_fit, g, h, ids0, B,
-            bm=spec.bm, use_bf16=spec.use_bf16, force_dense=spec.force_dense,
-        )  # (NW, F, B, 3)
+        hist0 = hist_call(pos_fit, ids0)  # (1, F, B, 3)
         root_ghc = jnp.sum(hist0[0, 0], axis=0)  # feature 0 bin-sum = totals
         tr = tr._replace(
             hess=tr.hess.at[0].set(root_ghc[1]),
@@ -308,10 +348,13 @@ def make_grow_tree(spec: GrowSpec):
             tr, fr, pool, pos, aux_pos, leaves = state
             return jnp.any(can_split(fr, tr, leaves))
 
-        def body(state):
+        def make_body(nw: int):
+            return lambda state: wave_body(state, nw)
+
+        def wave_body(state, nw: int):
             tr, fr, pool, pos, aux_pos, leaves = state
             ok = can_split(fr, tr, leaves)
-            sel, sel_ok = select(ok, fr, tr)
+            sel, sel_ok = select(ok, fr, tr, nw)
 
             # leaf budget count-off in selection order (level: node order
             # within the level; loss: gain order) — reference semantics
@@ -359,21 +402,29 @@ def make_grow_tree(spec: GrowSpec):
             )
 
             # routing (train + any aux sets)
-            pos = _route_wave(bins_t, pos, sel_ok, nid, f_best, slot_l, lch, rch, NW)
-            aux_pos = tuple(
-                _route_wave(bt, ap, sel_ok, nid, f_best, slot_l, lch, rch, NW)
-                for bt, ap in zip(aux, aux_pos)
-            )
+            if spec.force_dense:
+                pos = _route_wave(
+                    bins_t, pos, sel_ok, nid, f_best, slot_l, lch, rch, nw
+                )
+                aux_pos = tuple(
+                    _route_wave(bt, ap, sel_ok, nid, f_best, slot_l, lch, rch, nw)
+                    for bt, ap in zip(aux, aux_pos)
+                )
+            else:
+                pos = route_wave(
+                    bins_k, pos, sel_ok, nid, f_best, slot_l, lch, rch, bm=spec.bm
+                )
+                aux_pos = tuple(
+                    route_wave(bt, ap, sel_ok, nid, f_best, slot_l, lch, rch, bm=spec.bm)
+                    for bt, ap in zip(aux_k, aux_pos)
+                )
 
             # smaller-child histogram + sibling subtraction
             small = jnp.where(CLs <= CRs, lch, rch)
             big = jnp.where(CLs <= CRs, rch, lch)
             ids = jnp.where(sel_ok, small, -2)
             pos_fit = jnp.where(include, pos, -1)
-            h_small = hist_wave(
-                bins_t, pos_fit, g, h, ids, B,
-                bm=spec.bm, use_bf16=spec.use_bf16, force_dense=spec.force_dense,
-            )
+            h_small = hist_call(pos_fit, ids)
             parent_h = pool[nid]
             h_big = parent_h - h_small
             pool = pool.at[jnp.where(sel_ok, small, M)].set(h_small, **drop)
@@ -403,7 +454,17 @@ def make_grow_tree(spec: GrowSpec):
             return (tr, fr, pool, pos, aux_pos, (leaves + k_cnt).astype(jnp.int32))
 
         state = (tr, fr, pool, pos, aux_pos, leaves0)
-        tr, fr, pool, pos, aux_pos, leaves = jax.lax.while_loop(cond, body, state)
+        # slow start: after k waves at most 2^k nodes are expandable, so the
+        # first waves run right-sized (N = 1, 2, 4, ...) — identical split
+        # decisions to full-width waves at a fraction of the one-hot matmul
+        # rows (each wave's hist cost is proportional to its slot count)
+        nw_ss = 1
+        while nw_ss < NW:
+            state = wave_body(state, nw_ss)
+            nw_ss *= 2
+        tr, fr, pool, pos, aux_pos, leaves = jax.lax.while_loop(
+            cond, make_body(NW), state
+        )
         return tr, pos, aux_pos
 
     return grow

@@ -46,20 +46,19 @@ def _pad_to(x: int, m: int) -> int:
 
 @partial(jax.jit, static_argnames=("B", "bm", "fg", "use_bf16"))
 def _hist_pallas(
-    bins_t, pos, g, h, node_ids, B: int, bm: int, fg: int, use_bf16: bool
+    bins4, pos, g, h, node_ids, B: int, bm: int, fg: int, use_bf16: bool
 ):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    F, n = bins_t.shape
+    F, nblk = bins4.shape[0], bins4.shape[1]
+    n = nblk * bm
     N = node_ids.shape[0]
-    nblk = n // bm
     assert F % fg == 0, (F, fg)
     cdt = jnp.bfloat16 if use_bf16 else jnp.float32
     prec = None if use_bf16 else jax.lax.Precision.HIGHEST
     nt = (((1,), (1,)), ((), ()))  # A @ B.T
 
-    bins4 = bins_t.reshape(F, nblk, 1, bm)
     pos3 = pos.reshape(nblk, 1, bm)
     g3 = g.reshape(nblk, 1, bm)
     h3 = h.reshape(nblk, 1, bm)
@@ -74,7 +73,7 @@ def _hist_pallas(
         PV = jnp.concatenate([P * gv, P * hv, P], axis=0)  # (3N, bm)
         iota_b = jax.lax.broadcasted_iota(jnp.int32, (B, 1), 0)
         for fi in range(fg):
-            b = bins_ref[fi, 0, 0, :][None, :]  # (1, bm)
+            b = bins_ref[fi, 0, 0, :][None, :].astype(jnp.int32)  # (1, bm)
             OH = (iota_b == b).astype(cdt)  # (B, bm)
             acc = jax.lax.dot_general(
                 PV, OH, nt, precision=prec, preferred_element_type=jnp.float32
@@ -107,13 +106,115 @@ def _hist_pallas(
     return out  # (F, 3N, B), rows [g*N | h*N | c*N]
 
 
+@partial(jax.jit, static_argnames=("B", "bm", "fg"))
+def _hist_pallas_q(bins4, pos, gq, hq, node_ids, B: int, bm: int, fg: int):
+    """int8 variant: gq/hq are pre-quantized grads as f32 integers in
+    [-127, 127] (caller owns the scales); one-hots are exact, dots run at
+    2x MXU rate with i32 accumulation (|sum| <= bm*127 per tile, far from
+    overflow). Counts stay exact. Output (F, 3N, B) int32."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    F, nblk = bins4.shape[0], bins4.shape[1]
+    N = node_ids.shape[0]
+    assert F % fg == 0, (F, fg)
+    nt = (((1,), (1,)), ((), ()))  # A @ B.T
+
+    pos3 = pos.reshape(nblk, 1, bm)
+    g3 = gq.reshape(nblk, 1, bm)
+    h3 = hq.reshape(nblk, 1, bm)
+    ids2 = node_ids.reshape(N, 1)
+
+    def kernel(bins_ref, pos_ref, g_ref, h_ref, ids_ref, out_ref):
+        blk = pl.program_id(1)
+        p = pos_ref[0, 0, :][None, :]
+        Pb = ids_ref[:, 0:1] == p  # (N, bm) bool
+        # Mosaic legalizes neither int8 multiplies nor int8/i1 selects, so
+        # the masking runs in f32 (inputs are pre-rounded to [-127, 127])
+        # and the assembled block casts to int8 for the 2x-rate dot
+        P = Pb.astype(jnp.float32)
+        gv = P * g_ref[0, 0, :][None, :]
+        hv = P * h_ref[0, 0, :][None, :]
+        PV = jnp.concatenate([gv, hv, P], axis=0).astype(jnp.int8)  # (3N, bm)
+        iota_b = jax.lax.broadcasted_iota(jnp.int32, (B, 1), 0)
+        for fi in range(fg):
+            b = bins_ref[fi, 0, 0, :][None, :].astype(jnp.int32)
+            OH = (iota_b == b).astype(jnp.int8)  # (B, bm)
+            acc = jax.lax.dot_general(
+                PV, OH, nt, preferred_element_type=jnp.int32
+            )  # (3N, B) i32
+
+            @pl.when(blk == 0)
+            def _():
+                out_ref[fi, :, :] = acc
+
+            @pl.when(blk > 0)
+            def _():
+                out_ref[fi, :, :] = out_ref[fi, :, :] + acc
+
+    return pl.pallas_call(
+        kernel,
+        grid=(F // fg, nblk),
+        in_specs=[
+            pl.BlockSpec((fg, 1, 1, bm), lambda fo, k: (fo, k, 0, 0)),
+            pl.BlockSpec((1, 1, bm), lambda fo, k: (k, 0, 0)),
+            pl.BlockSpec((1, 1, bm), lambda fo, k: (k, 0, 0)),
+            pl.BlockSpec((1, 1, bm), lambda fo, k: (k, 0, 0)),
+            pl.BlockSpec((N, 1), lambda fo, k: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((fg, 3 * N, B), lambda fo, k: (fo, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((F, 3 * N, B), jnp.int32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+    )(bins4, pos3, g3, h3, ids2)
+
+
+@partial(jax.jit, static_argnames=("B",))
+def _hist_dense_q(bins_t, pos, gq, hq, node_ids, B: int):
+    """int8 math via int32 einsum (CPU / fallback path for the q kernel);
+    gq/hq are f32 integers in [-127, 127]."""
+    P = (node_ids[:, None] == pos[None, :]).astype(jnp.int32)
+    OH = (
+        bins_t.astype(jnp.int32)[:, None, :] == jnp.arange(B)[None, :, None]
+    ).astype(jnp.int32)
+    gi = gq.astype(jnp.int32)
+    hi = hq.astype(jnp.int32)
+    hg = jnp.einsum("xn,fbn->fxb", P * gi[None, :], OH)
+    hh = jnp.einsum("xn,fbn->fxb", P * hi[None, :], OH)
+    hc = jnp.einsum("xn,fbn->fxb", P, OH)
+    return jnp.concatenate([hg, hh, hc], axis=1)  # (F, 3N, B) i32
+
+
+def hist_wave_q(
+    bins_t, pos, gq, hq, node_ids, B: int, bm: int = BM_DEFAULT,
+    force_dense: bool = False,
+):
+    """(N, F, B, 3) int32 histograms from int8-quantized grads."""
+    F = bins_t.shape[0]
+    N = node_ids.shape[0]
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu and not force_dense:
+        bins4 = (
+            bins_t
+            if bins_t.ndim == 4
+            else bins_t.reshape(F, bins_t.shape[1] // bm, 1, bm)
+        )
+        out = _hist_pallas_q(bins4, pos, gq, hq, node_ids, B, bm, _pick_fg(F))
+    else:
+        bins2 = bins_t if bins_t.ndim == 2 else bins_t.reshape(F, -1)
+        out = _hist_dense_q(bins2, pos, gq, hq, node_ids, B)
+    out = out.reshape(F, 3, N, B)
+    return jnp.transpose(out, (2, 0, 3, 1))
+
+
 @partial(jax.jit, static_argnames=("B", "use_bf16"))
 def _hist_dense(bins_t, pos, g, h, node_ids, B: int, use_bf16: bool):
     """Same math as the Pallas kernel via einsum (CPU / fallback path)."""
     cdt = jnp.bfloat16 if use_bf16 else jnp.float32
     P = (node_ids[:, None] == pos[None, :]).astype(cdt)  # (N, n)
     OH = (
-        bins_t[:, None, :] == jnp.arange(B)[None, :, None]
+        bins_t.astype(jnp.int32)[:, None, :] == jnp.arange(B)[None, :, None]
     ).astype(cdt)  # (F, B, n)
     gv = g.astype(cdt)
     hv = h.astype(cdt)
@@ -148,15 +249,21 @@ def hist_wave(
     g, h     (n,) f32     — weighted grad / hess per sample
     node_ids (N,) int32   — node ids to histogram (-2 pads: match nothing)
     """
-    F, n = bins_t.shape
+    F = bins_t.shape[0]
     N = node_ids.shape[0]
     on_tpu = jax.default_backend() == "tpu"
     if on_tpu and not force_dense:
+        bins4 = (
+            bins_t
+            if bins_t.ndim == 4
+            else bins_t.reshape(F, bins_t.shape[1] // bm, 1, bm)
+        )
         out = _hist_pallas(
-            bins_t, pos, g, h, node_ids, B, bm, _pick_fg(F), use_bf16
+            bins4, pos, g, h, node_ids, B, bm, _pick_fg(F), use_bf16
         )
     else:
-        out = _hist_dense(bins_t, pos, g, h, node_ids, B, use_bf16)
+        bins2 = bins_t if bins_t.ndim == 2 else bins_t.reshape(F, -1)
+        out = _hist_dense(bins2, pos, g, h, node_ids, B, use_bf16)
     # (F, 3N, B) -> (N, F, B, 3)
     out = out.reshape(F, 3, N, B)
     return jnp.transpose(out, (2, 0, 3, 1))

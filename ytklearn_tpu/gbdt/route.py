@@ -1,0 +1,95 @@
+"""Sample-position routing kernel — the SamplePositionData equivalent.
+
+The XLA formulation (engine._route_wave) runs NW sequential full-array
+passes per wave: each slot re-reads one bins row (42 MB at 10.5M rows)
+AND rewrites the whole pos array — ~1.3 GB of HBM traffic per 16-slot
+wave. This kernel does the whole wave in ONE pass: per sample block it
+loads the block's bin rows once, resolves every slot's compare/select in
+VMEM, and writes pos once (~0.3 GB per wave with uint8 bins).
+
+Reference: SamplePositionData.resetPosition:115 (partition samples of a
+split node between its children).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("bm",))
+def _route_pallas(bins4, pos, valid, nid, feat, slot, lch, rch, bm: int):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    F, nblk = bins4.shape[0], bins4.shape[1]
+    n = nblk * bm
+    NW = nid.shape[0]
+    pos3 = pos.reshape(nblk, 1, bm)
+    # pack the per-slot scalars into one (8, NW) i32 table (SMEM-resident)
+    tab = jnp.stack(
+        [
+            valid.astype(jnp.int32),
+            nid,
+            feat,
+            slot,
+            lch,
+            rch,
+            jnp.zeros((NW,), jnp.int32),
+            jnp.zeros((NW,), jnp.int32),
+        ]
+    )
+
+    def kernel(tab_ref, bins_ref, pos_ref, out_ref):
+        p = pos_ref[0, 0, :][None, :]  # (1, bm)
+        newp = p
+        for i in range(NW):
+            f = tab_ref[2, i]
+            row = bins_ref[pl.ds(f, 1), 0, 0, :]  # (1, bm), dynamic sublane
+            m = (p == tab_ref[1, i]) & (tab_ref[0, i] != 0)
+            child = jnp.where(
+                row.astype(jnp.int32) > tab_ref[3, i], tab_ref[5, i], tab_ref[4, i]
+            )
+            newp = jnp.where(m, child, newp)
+        out_ref[0, 0, :] = newp[0]
+
+    return pl.pallas_call(
+        kernel,
+        grid=(nblk,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((F, 1, 1, bm), lambda k: (0, k, 0, 0)),
+            pl.BlockSpec((1, 1, bm), lambda k: (k, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, bm), lambda k: (k, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((nblk, 1, bm), jnp.int32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+        ),
+    )(tab, bins4, pos3).reshape(n)
+
+
+def route_wave(bins_t, pos, valid, nid, feat, slot, lch, rch, bm: int = 8192):
+    """One-pass wave routing; XLA fallback off-TPU (see engine._route_wave).
+
+    bins_t: (F, n) or pre-tiled (F, nblk, 1, bm)."""
+    F = bins_t.shape[0]
+    if jax.default_backend() == "tpu":
+        bins4 = (
+            bins_t
+            if bins_t.ndim == 4
+            else bins_t.reshape(F, bins_t.shape[1] // bm, 1, bm)
+        )
+        return _route_pallas(
+            bins4, pos, valid, nid,
+            jnp.maximum(feat, 0), slot, lch, rch, bm,
+        )
+    from .engine import _route_wave
+
+    bins2 = bins_t if bins_t.ndim == 2 else bins_t.reshape(F, -1)
+    return _route_wave(
+        bins2, pos, valid, nid, jnp.maximum(feat, 0), slot, lch, rch,
+        nid.shape[0],
+    )

@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import logging
 import math
+import os
 import time
 from dataclasses import dataclass, field
 from functools import partial
@@ -140,6 +141,7 @@ class GBDTTrainer:
         engine: str = "auto",
         wave: Optional[int] = None,
         use_bf16_hist: bool = True,
+        hist_precision: Optional[str] = None,  # bf16 | f32 | int8
     ):
         self.params = params
         self.mesh = mesh
@@ -161,7 +163,14 @@ class GBDTTrainer:
             )
         self.engine = engine
         self.wave = wave
-        self.use_bf16_hist = use_bf16_hist
+        if hist_precision is None:
+            hist_precision = "bf16" if use_bf16_hist else "f32"
+        if hist_precision not in ("bf16", "f32", "int8"):
+            raise ValueError(
+                f"hist_precision must be bf16|f32|int8, got {hist_precision!r}"
+            )
+        self.hist_precision = hist_precision
+        self.use_bf16_hist = hist_precision != "f32" 
 
     def _put(self, arr):
         if self.mesh is None:
@@ -210,7 +219,10 @@ class GBDTTrainer:
         if self.wave is not None:
             NW = self.wave
         else:
-            NW = 64 if p.tree_grow_policy == "level" else 16
+            # loss policy: 32 measured fastest at Higgs scale (wave cost is
+            # ~flat in slot count until ~2 MXU row-tiles; wider waves halve
+            # the full-data passes)
+            NW = 64 if p.tree_grow_policy == "level" else 32
         NW = max(1, min(NW, (M + 1) // 2))
         force_dense = jax.default_backend() != "tpu" or (
             self.mesh is not None and self.mesh.devices.size > 1
@@ -232,6 +244,7 @@ class GBDTTrainer:
             min_split_samples=float(p.min_split_samples),
             use_bf16=self.use_bf16_hist,
             force_dense=force_dense,
+            hist_mode="int8" if self.hist_precision == "int8" else "mxu",
         )
 
     def _train_device(
@@ -239,8 +252,10 @@ class GBDTTrainer:
     ) -> GBDTResult:
         p = self.params
         t0 = time.time()
+        ts = self.time_stats = {}  # TimeStats equivalent (data/gbdt/TimeStats.java)
         if train is None:
             train, test = GBDTIngest(p, self.fs).load()
+        ts["load"] = time.time() - t0
         n_real, F = train.n_real, train.n_features
         K = self.K
         self._missing_fill = train.missing_fill
@@ -267,6 +282,8 @@ class GBDTTrainer:
             n_pad = -(-n_rows // BM_DEFAULT) * BM_DEFAULT
             Xp = jnp.pad(X_t_dev, ((0, 0), (0, n_pad - n_rows)))
             bins_t = bin_matrix_device(Xp, bins)
+            if B <= 256:
+                bins_t = bins_t.astype(jnp.uint8)  # quarter the routing/DMA
             del X_t_dev, Xp
         else:
             bins_np = bin_matrix(train.X, bins)
@@ -275,6 +292,7 @@ class GBDTTrainer:
         y = self._put(_pad0(train.y, n_pad))
         weight = self._put(_pad0(train.weight, n_pad))
         real_mask = self._put(np.arange(n_pad) < train.X.shape[0])
+        ts["preprocess"] = time.time() - t0 - ts["load"]
         log.info(
             "load+preprocess %.1fs: %d rows, %d features, %d bins (pad %d)",
             time.time() - t0, n_real, F, B_real, B,
@@ -313,8 +331,11 @@ class GBDTTrainer:
                 Xt_t = jnp.pad(
                     jnp.transpose(jax.device_put(test.X)), ((0, 0), (0, nt_pad - nt))
                 )
-                aux_bins = (bin_matrix_device(Xt_t, bins),)
-                del Xt_t
+                bt_dev = bin_matrix_device(Xt_t, bins)
+                if B <= 256:
+                    bt_dev = bt_dev.astype(jnp.uint8)
+                aux_bins = (bt_dev,)
+                del Xt_t, bt_dev
             else:
                 bins_test_np = bin_matrix(test.X, bins)
                 bt_np, nt_pad = pad_inputs(bins_test_np)
@@ -437,6 +458,10 @@ class GBDTTrainer:
         carry = (scores, scores_t, bufs, loss_buf, tloss_buf)
         sync_every = max(1, (p.round_num - start_round) // 20)
         self.sync_log: List[Tuple[int, float]] = []  # (round, wall s) at syncs
+        profile_dir = os.environ.get("YTK_PROFILE_DIR")
+        if profile_dir:
+            jax.profiler.start_trace(profile_dir)
+        t_train0 = time.time()
         for rnd in range(start_round, p.round_num):
             carry = jit_round(
                 carry, jnp.asarray(rnd), jax.random.fold_in(root_key, rnd), data
@@ -456,12 +481,35 @@ class GBDTTrainer:
                 )
                 self._dump_model(model)
 
+        if profile_dir:
+            jax.block_until_ready(carry[0])
+            jax.profiler.stop_trace()
+            log.info("jax profiler trace written to %s", profile_dir)
+        ts["train"] = time.time() - t_train0
+        if self.sync_log:
+            # skip the first sync window: it absorbs the one-time XLA compile
+            r0, s0 = self.sync_log[1] if len(self.sync_log) >= 3 else self.sync_log[0]
+            r1, s1 = self.sync_log[-1]
+            if r1 > r0:
+                ts["trees_per_sec_steady"] = (r1 - r0) * K / max(s1 - s0, 1e-9)
         scores, scores_t, bufs, loss_buf, tloss_buf = carry
-        return self._finalize_device(
+        t_fin = time.time()
+        out = self._finalize_device(
             model, bins, scores, y, weight, scores_t, y_t, w_t,
             bufs, loss_buf, tloss_buf, start_round, train.feature_names, t0,
             trained_rounds=p.round_num,
         )
+        ts["finalize"] = time.time() - t_fin
+        log.info(
+            "[time stats] load=%.1fs preprocess=%.1fs train=%.1fs "
+            "finalize=%.1fs%s",
+            ts["load"], ts["preprocess"], ts["train"], ts["finalize"],
+            (
+                f" steady={ts['trees_per_sec_steady']:.2f} trees/s"
+                if "trees_per_sec_steady" in ts else ""
+            ),
+        )
+        return out
 
     def _base_score(self, train: GBDTData, K: int):
         p = self.params
@@ -506,8 +554,9 @@ class GBDTTrainer:
         if want <= have:
             return
         # slice on device first: dump_freq checkpoints fetch only the new
-        # trees, not the whole (T, M) run buffers (D2H is ~115ms/transfer)
-        host = {k: np.asarray(v[have:want]) for k, v in bufs.items()}
+        # trees, not the whole (T, M) run buffers; one batched device_get
+        # instead of 10 sequential fetches (D2H is ~115ms/transfer)
+        host = jax.device_get({k: v[have:want] for k, v in bufs.items()})
         for i in range(want - have):
             model.trees.append(
                 self._arrays_to_tree(

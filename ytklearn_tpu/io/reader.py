@@ -227,6 +227,22 @@ class IngestResult:
     y_weight_stat: Optional[np.ndarray] = None
 
 
+def shard_read_lines(fs, data_params, paths):
+    """This process's line shard (reference: DataFlow.java:391-410 —
+    assigned mode reads everything; unassigned splits by files_avg or
+    line-modulo lines_avg across processes)."""
+    import jax
+
+    n_proc = jax.process_count()
+    proc = jax.process_index()
+    if data_params.assigned or n_proc == 1:
+        return fs.read_lines(paths)
+    if data_params.unassigned_mode == "files_avg":
+        files = sorted(fs.recur_get_paths(paths))
+        return fs.read_lines(files[proc::n_proc])
+    return fs.select_read_lines(paths, n_proc, proc)
+
+
 class DataIngest:
     """Single-host ingest (the TPU host driver replaces per-thread CoreData
     shards: one process parses, the mesh shards rows on device). Multi-host
@@ -504,13 +520,7 @@ class DataIngest:
         proc = jax.process_index()
 
         def read(paths: Sequence[str]) -> Iterator[str]:
-            if p.data.assigned or n_proc == 1:
-                return self.fs.read_lines(paths)
-            if p.data.unassigned_mode == "files_avg":
-                files = sorted(self.fs.recur_get_paths(paths))
-                share = files[proc::n_proc]
-                return self.fs.read_lines(share)
-            return self.fs.select_read_lines(paths, n_proc, proc)
+            return shard_read_lines(self.fs, p.data, paths)
 
         train_rows = self.parse_rows(
             read(p.data.train_paths), p.data.train_max_error_tol, is_train=True
