@@ -1,0 +1,157 @@
+"""Native C++ parser (native/ytk_parse.cpp via io.native) parity with the
+pure-python ingest path — same rows, errors, first-seen dict order, dense
+matrix, and shard selection (reference semantics: dataflow/CoreData.java
+readData + fs selectRead)."""
+
+import numpy as np
+import pytest
+
+from ytklearn_tpu.config.params import GBDTParams
+from ytklearn_tpu.gbdt.data import GBDTIngest
+from ytklearn_tpu.io import native
+
+pytestmark = pytest.mark.skipif(
+    not native.native_available(), reason="native parser unavailable"
+)
+
+MESSY = (
+    "1###0###f1:1.5,f2:2\n"
+    "2###1###f3:+3.5,f1:0.25\n"
+    "garbage line\n"
+    "1### 1 ### f2 : 7 \n"
+    "\n"
+    "   \n"
+    "1###0###\n"
+    "0.5###1###f9:1e-3,f1:-2.5,f9:4\n"
+    "1###0###fx:nan,f2:inf\n"
+    "1###notanumber###f1:1\n"
+    "1###1###f1\n"
+    "--1###0###f1:1\n"  # double sign: error in python float()
+    "+-2###1###f2:2\n"
+    "1###--5###f3:3\n"
+    "1###0###f1:1_5\n"  # digit underscore: python float('1_5') == 15
+    "1###0###f1:_5\n"  # leading underscore: error
+)
+
+
+def _ingest(tmp_path, text, K=1, F=8, tol=10):
+    p = tmp_path / "data.txt"
+    p.write_text(text)
+    params = GBDTParams(loss_function="softmax" if K > 1 else "sigmoid",
+                        class_num=K)
+    params.data.max_feature_dim = F
+    params.data.train_paths = [str(p)]
+    params.data.train_max_error_tol = tol
+    return GBDTIngest(params)
+
+
+def test_messy_parity(tmp_path):
+    ing = _ingest(tmp_path, MESSY)
+    a = ing._parse_native([str(tmp_path / "data.txt")], 10)
+    fa = dict(ing._fmap)
+    b = ing._parse_python([str(tmp_path / "data.txt")], 10)
+    fb = dict(ing._fmap)
+    assert fa == fb
+    assert a.n_real == b.n_real
+    np.testing.assert_array_equal(a.weight, b.weight)
+    np.testing.assert_array_equal(a.y, b.y)
+    np.testing.assert_array_equal(np.isnan(a.X), np.isnan(b.X))
+    np.testing.assert_array_equal(np.nan_to_num(a.X, nan=-9e9),
+                                  np.nan_to_num(b.X, nan=-9e9))
+    assert a.feature_names == b.feature_names
+
+
+def test_error_tolerance_exceeded(tmp_path):
+    ing = _ingest(tmp_path, MESSY, tol=1)
+    with pytest.raises(Exception):
+        ing._parse_native([str(tmp_path / "data.txt")], 1)
+    ing2 = _ingest(tmp_path, MESSY, tol=1)
+    with pytest.raises(Exception):
+        ing2._parse_python([str(tmp_path / "data.txt")], 1)
+
+
+def test_multiclass_parity(tmp_path):
+    text = (
+        "1###2###f1:1,f2:2\n"
+        "1###0,0,1###f2:3\n"
+        "1###5###f1:1\n"  # class out of range -> error line
+        "1###0,1###f1:1\n"  # wrong label width -> error line
+        "1###1.7###f3:4\n"  # truncates to class 1 (python int())
+    )
+    ing = _ingest(tmp_path, text, K=3)
+    a = ing._parse_native([str(tmp_path / "data.txt")], 10)
+    b = _ingest(tmp_path, text, K=3)._parse_python([str(tmp_path / "data.txt")], 10)
+    assert a.n_real == b.n_real == 3
+    np.testing.assert_array_equal(a.y, b.y)
+    np.testing.assert_array_equal(np.nan_to_num(a.X, nan=-9e9),
+                                  np.nan_to_num(b.X, nan=-9e9))
+
+
+def test_max_feature_dim_overflow(tmp_path):
+    text = "1###0###a:1,b:2,c:3\n"
+    ing = _ingest(tmp_path, text, F=2)
+    with pytest.raises(ValueError, match="max_feature_dim"):
+        ing._parse_native([str(tmp_path / "data.txt")], 0)
+
+
+def test_overflow_rows_tolerated_as_error_lines(tmp_path):
+    # python-path semantics: a row whose new features exceed max_feature_dim
+    # is an error line — skipped, claims no columns; LATER rows may still
+    # claim its other names (here 'b' lands via row 3)
+    text = "1###0###a:1\n1###1###b:2,c:3,dd:4\n1###0###b:5\n"
+    a = _ingest(tmp_path, text, F=2, tol=5)._parse_native(
+        [str(tmp_path / "data.txt")], 5)
+    b = _ingest(tmp_path, text, F=2, tol=5)._parse_python(
+        [str(tmp_path / "data.txt")], 5)
+    assert a.n_real == b.n_real == 2
+    np.testing.assert_array_equal(np.nan_to_num(a.X, nan=-9e9),
+                                  np.nan_to_num(b.X, nan=-9e9))
+    assert a.feature_names == b.feature_names
+
+
+def test_multichar_delim_falls_back_to_python(tmp_path):
+    p = tmp_path / "d.txt"
+    p.write_text("1###0###a:1||b:2\n")
+    params = GBDTParams(loss_function="sigmoid")
+    params.data.max_feature_dim = 4
+    params.data.train_paths = [str(p)]
+    params.data.delim.features_delim = "||"
+    ing = GBDTIngest(params)
+    out = ing._parse([str(p)], 0)
+    assert out.n_real == 1 and set(ing._fmap) == {"a", "b"}
+
+
+def test_frozen_test_set(tmp_path):
+    train = "1###0###a:1,b:2\n1###1###c:3\n"
+    test = "1###1###b:5,zz:9,a:1\n"
+    ing = _ingest(tmp_path, train)
+    ing._parse_native([str(tmp_path / "data.txt")], 0)
+    fmap = ing._fmap
+    tp = tmp_path / "test.txt"
+    tp.write_text(test)
+    t_native = ing._parse_native([str(tp)], 0, fmap=dict(fmap), frozen=True)
+    t_py = ing._parse_python([str(tp)], 0, fmap=dict(fmap), frozen=True)
+    np.testing.assert_array_equal(np.nan_to_num(t_native.X, nan=-9e9),
+                                  np.nan_to_num(t_py.X, nan=-9e9))
+    # zz dropped: only a, b columns set
+    assert np.isnan(t_native.X[0, fmap["c"]])
+
+
+def test_line_modulo_shard():
+    data = b"".join(f"1###0###f:{i}\n".encode() for i in range(10))
+    blk = native.parse_block(data, divisor=3, remainder=1)
+    np.testing.assert_array_equal(blk.feat_vals, [1.0, 4.0, 7.0])
+
+
+def test_parse_block_threads_deterministic():
+    data = b"".join(
+        f"1###{i % 2}###f{i % 17}:{i},g{i % 5}:{i * 2}\n".encode()
+        for i in range(5000)
+    )
+    one = native.parse_block(data, n_threads=1)
+    many = native.parse_block(data, n_threads=7)
+    assert one.names == many.names
+    np.testing.assert_array_equal(one.row_ptr, many.row_ptr)
+    np.testing.assert_array_equal(one.feat_ids, many.feat_ids)
+    np.testing.assert_array_equal(one.feat_vals, many.feat_vals)
+    np.testing.assert_array_equal(one.labels, many.labels)

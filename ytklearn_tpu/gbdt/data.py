@@ -97,7 +97,176 @@ class GBDTIngest:
         """fmap: feature name -> dense column, grown in first-seen order while
         parsing train data, frozen for test data — the reference's
         OnlineFeatureMap (GBDTCoreData.java:371-381: unseen test features are
-        skipped, train overflow past max_feature_dim is a checked error)."""
+        skipped, train overflow past max_feature_dim is a checked error).
+
+        Dispatches to the native C++ parser (io.native) when available and no
+        python transform hook is configured; both paths produce identical
+        output (tests/test_native_ingest.py)."""
+        if self.transform_hook is None:
+            from ..io import native
+
+            if (native.native_available()
+                    and native.supports_delims(self.params.data.delim)):
+                return self._parse_native(paths, max_error_tol, fmap, frozen)
+        return self._parse_python(paths, max_error_tol, fmap, frozen)
+
+    def _parse_native(
+        self,
+        paths,
+        max_error_tol: int,
+        fmap: Optional[Dict[str, int]] = None,
+        frozen: bool = False,
+    ) -> GBDTData:
+        """Columnar native parse -> vectorized dense-matrix assembly."""
+        from ..io import native
+        from ..io.reader import shard_plan
+
+        dp = self.params.data
+        paths, divisor, remainder = shard_plan(self.fs, dp, paths)
+        buf = native.read_paths_bytes(self.fs, paths)
+        d = dp.delim
+        blk = native.parse_block(
+            buf, d.x_delim, d.y_delim, d.features_delim,
+            d.feature_name_val_delim, divisor=divisor, remainder=remainder,
+        )
+
+        # label-shape validation (python path: errors counted per bad row)
+        widths = np.diff(blk.label_ptr)
+        n_errors = blk.n_errors
+        if self.K > 1:
+            bad = (widths != 1) & (widths != self.K)
+            first = blk.labels[blk.label_ptr[:-1]]
+            is_cls = widths == 1
+            # python-path semantics: int() truncates toward zero; a negative
+            # in-range index wraps (list indexing); out of [-K, K-1] raises
+            cls = np.trunc(first).astype(np.int64)
+            bad |= is_cls & ((cls >= self.K) | (cls < -self.K))
+        else:
+            bad = np.zeros(blk.n, bool)
+        n_errors += int(bad.sum())
+        keep = ~bad
+
+        # feature-name -> column map, continuing any existing dict. Bad-label
+        # rows claim no columns (python path: fmap.update happens only after
+        # the whole line validates). Names go in by first-seen (row, in-row
+        # position) order over kept rows.
+        if fmap is None:
+            fmap = {}
+        rows_all = np.repeat(np.arange(blk.n), np.diff(blk.row_ptr))
+        kept_feat = keep[rows_all]
+        col_of_local = np.full(len(blk.names), -1, np.int64)
+        unknown = []
+        for lid, name in enumerate(blk.names):
+            idx = fmap.get(name)
+            if idx is not None:
+                col_of_local[lid] = idx
+            else:
+                unknown.append(lid)
+        if unknown and not frozen:
+            unknown = np.asarray(unknown, np.int64)
+            unk_mask = np.zeros(len(blk.names), bool)
+            unk_mask[unknown] = True
+            sel = kept_feat & unk_mask[blk.feat_ids]
+            u_rows = rows_all[sel]
+            u_ids = blk.feat_ids[sel]
+            # restrict to names actually used by kept rows
+            present = np.unique(u_ids)
+            if len(fmap) + len(present) <= self.F:
+                # fast path: everything fits — assign by global first-seen
+                # order, fully vectorized (the common case)
+                first_idx = np.full(len(blk.names), np.iinfo(np.int64).max)
+                np.minimum.at(first_idx, u_ids, np.arange(len(u_ids)))
+                for lid in present[np.argsort(first_idx[present], kind="stable")]:
+                    fmap[blk.names[lid]] = len(fmap)
+                    col_of_local[lid] = fmap[blk.names[lid]]
+            else:
+                # overflow: emulate the python path row-by-row — a row whose
+                # staging would exceed max_feature_dim is an ERROR LINE (it
+                # claims no columns, counts toward max_error_tol, and later
+                # rows may still claim its other names)
+                bad_cap = np.zeros(blk.n, bool)
+                last_name = ""
+                boundaries = np.flatnonzero(np.diff(u_rows)) + 1
+                for g in np.split(np.arange(len(u_rows)), boundaries):
+                    if len(g) == 0:
+                        continue
+                    staged: List[int] = []
+                    seen = set()
+                    ok = True
+                    for occ in g:
+                        lid = int(u_ids[occ])
+                        if col_of_local[lid] >= 0 or lid in seen:
+                            continue
+                        if len(fmap) + len(staged) >= self.F:
+                            ok = False
+                            last_name = blk.names[lid]
+                            break
+                        seen.add(lid)
+                        staged.append(lid)
+                    if ok:
+                        for lid in staged:
+                            fmap[blk.names[lid]] = len(fmap)
+                            col_of_local[lid] = fmap[blk.names[lid]]
+                    else:
+                        bad_cap[u_rows[g[0]]] = True
+                n_errors += int(bad_cap.sum())
+                if n_errors > max_error_tol:
+                    raise ValueError(
+                        f"max_feature_dim({self.F}) smaller than real "
+                        f"feature number in data set (feature {last_name!r})"
+                    )
+                keep &= ~bad_cap
+                kept_feat = keep[rows_all]
+        if n_errors > max_error_tol:
+            raise ValueError(
+                f"data error lines ({n_errors}) exceed max_error_tol "
+                f"({max_error_tol})"
+            )
+        self._fmap = fmap
+
+        # assemble dense matrix over kept rows. numpy fancy assignment with
+        # duplicate (row, col) pairs has unspecified winner, but the python
+        # path's sequential store makes the LAST in-row occurrence win —
+        # dedup keep-last before the scatter
+        new_row = np.cumsum(keep) - 1
+        n = int(keep.sum())
+        X = np.full((n, self.F), np.nan, np.float32)
+        cols = col_of_local[blk.feat_ids]
+        m = kept_feat & (cols >= 0)
+        r = new_row[rows_all[m]]
+        c = cols[m]
+        v = blk.feat_vals[m]
+        flat = r * np.int64(self.F) + c
+        last = len(flat) - 1 - np.unique(flat[::-1], return_index=True)[1]
+        X[r[last], c[last]] = v[last]
+        weight = blk.weights[keep].astype(np.float32)
+        if self.K > 1:
+            y = np.zeros((n, self.K), np.float32)
+            kidx = np.where(keep)[0]
+            wk = widths[kidx]
+            # explicit K-vector rows
+            full = wk == self.K
+            if full.any():
+                src = blk.label_ptr[kidx[full]][:, None] + np.arange(self.K)
+                y[np.where(full)[0]] = blk.labels[src]
+            one = ~full
+            if one.any():
+                cls_k = np.trunc(blk.labels[blk.label_ptr[kidx[one]]]).astype(np.int64)
+                cls_k = np.where(cls_k < 0, cls_k + self.K, cls_k)
+                y[np.where(one)[0], cls_k] = 1.0
+        else:
+            y = blk.labels[blk.label_ptr[:-1]][keep].astype(np.float32)
+        return GBDTData(X=X, y=y, weight=weight, n_real=n,
+                        feature_names=self._names_from_fmap(fmap))
+
+    def _parse_python(
+        self,
+        paths,
+        max_error_tol: int,
+        fmap: Optional[Dict[str, int]] = None,
+        frozen: bool = False,
+    ) -> GBDTData:
+        """Pure-python reference path (also the transform-hook path)."""
         delim = self.params.data.delim
         if fmap is None:
             fmap = {}
@@ -157,10 +326,15 @@ class GBDTIngest:
                 y[i] = labels[0]
             for fid, v in feats:
                 X[i, fid] = v
+        return GBDTData(X=X, y=y, weight=weight, n_real=n,
+                        feature_names=self._names_from_fmap(fmap))
+
+    def _names_from_fmap(self, fmap: Dict[str, int]) -> List[str]:
+        """index -> name, unclaimed dense columns keeping numeric names."""
         names = [str(i) for i in range(self.F)]
         for name, idx in fmap.items():
             names[idx] = name
-        return GBDTData(X=X, y=y, weight=weight, n_real=n, feature_names=names)
+        return names
 
     def compute_missing_fill(self, X: np.ndarray) -> np.ndarray:
         """(F,) fill values per the configured strategy, globally merged
@@ -241,12 +415,9 @@ class GBDTIngest:
         for name, old in self._fmap.items():
             X[:, gmap[name]] = train.X[:, old]
         self._fmap = gmap
-        new_names = [str(i) for i in range(self.F)]
-        for n, i in gmap.items():
-            new_names[i] = n
         return GBDTData(
             X=X, y=train.y, weight=train.weight, n_real=train.n_real,
-            feature_names=new_names,
+            feature_names=self._names_from_fmap(gmap),
         )
 
     def load(self) -> Tuple[GBDTData, Optional[GBDTData]]:

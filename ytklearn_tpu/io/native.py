@@ -1,0 +1,217 @@
+"""ctypes bindings for the native C++ ingest parser (native/ytk_parse.cpp).
+
+The .so is compiled on demand with g++ (cached by source mtime under
+native/build/). Callers use `native_available()` and fall back to the pure
+Python parser when the toolchain is missing — the native path is an exact
+drop-in (same rows, same errors, same first-seen feature-name order; parity
+enforced by tests/test_native_ingest.py).
+
+TPU-native framing: this is the runtime's data-loader component — the
+reference parallelizes ingest across Java reader threads
+(dataflow/DataFlow.java:483-534 readQueues + per-thread CoreData.readData);
+here the same row-range parallelism is std::thread workers over one byte
+buffer, feeding numpy columnar arrays that are a single device_put away
+from the mesh.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from dataclasses import dataclass
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO, "native", "ytk_parse.cpp")
+_SO = os.path.join(_REPO, "native", "build", "libytkparse.so")
+
+_lock = threading.Lock()
+_lib = None
+_lib_failed = False
+
+
+def _build() -> bool:
+    os.makedirs(os.path.dirname(_SO), exist_ok=True)
+    # per-process temp name: concurrent builders (multi-host JAX on one
+    # machine, parallel pytest) each compile privately, then atomically
+    # promote — last os.replace wins, never a torn .so
+    tmp = f"{_SO}.{os.getpid()}.tmp"
+    cmd = [
+        "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+        "-march=native", _SRC, "-o", tmp,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except Exception as e:  # toolchain missing / compile error -> fallback
+        err = getattr(e, "stderr", b"")
+        log.warning("native parser build failed (%s); using python parser: %s",
+                    e, err.decode()[:500] if err else "")
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+    os.replace(tmp, _SO)
+    return True
+
+
+def _load():
+    global _lib, _lib_failed
+    with _lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        if os.environ.get("YTK_NO_NATIVE"):
+            _lib_failed = True
+            return None
+        try:
+            stale = (not os.path.exists(_SO)
+                     or os.path.getmtime(_SO) < os.path.getmtime(_SRC))
+        except OSError:
+            stale = True
+        if stale and not _build():
+            _lib_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError as e:
+            log.warning("native parser load failed: %s", e)
+            _lib_failed = True
+            return None
+        lib.ytk_parse.restype = ctypes.c_void_p
+        lib.ytk_parse.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int32, ctypes.c_int64,
+            ctypes.c_int64,
+        ]
+        for name in ("ytk_n_rows", "ytk_nnz", "ytk_n_label_vals",
+                     "ytk_n_names", "ytk_name_bytes", "ytk_n_errors"):
+            fn = getattr(lib, name)
+            fn.restype = ctypes.c_int64
+            fn.argtypes = [ctypes.c_void_p]
+        lib.ytk_fill.restype = None
+        lib.ytk_fill.argtypes = [ctypes.c_void_p] + [ctypes.c_void_p] * 7
+        lib.ytk_free.restype = None
+        lib.ytk_free.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+@dataclass
+class ParsedBlock:
+    """Columnar parse result for a block of lines.
+
+    Rows appear in input-line order. `labels` is ragged via label_ptr
+    (1 entry for scalar losses, K for explicit multiclass vectors).
+    `feat_ids` index into `names` (first-seen order across kept lines).
+    """
+
+    weights: np.ndarray  # (n,) f32
+    label_ptr: np.ndarray  # (n+1,) i64
+    labels: np.ndarray  # (L,) f32
+    row_ptr: np.ndarray  # (n+1,) i64
+    feat_ids: np.ndarray  # (nnz,) i32 -> names
+    feat_vals: np.ndarray  # (nnz,) f32
+    names: List[str]
+    n_errors: int
+
+    @property
+    def n(self) -> int:
+        return len(self.weights)
+
+
+def parse_block(
+    data: bytes,
+    x_delim: str = "###",
+    y_delim: str = ",",
+    features_delim: str = ",",
+    feature_name_val_delim: str = ":",
+    n_threads: int = 0,
+    divisor: int = 1,
+    remainder: int = 0,
+) -> ParsedBlock:
+    """Parse a byte buffer of ytklearn-format lines natively.
+
+    divisor/remainder implement the global line-modulo shard selection
+    (fs.select_read_lines / reference IFileSystem.selectRead).
+    """
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native parser unavailable")
+    if len(y_delim) != 1 or len(features_delim) != 1 or len(feature_name_val_delim) != 1:
+        raise ValueError("native parser requires single-char y/features/name-val delims")
+    if n_threads <= 0:
+        n_threads = min(os.cpu_count() or 1, 32)
+    h = lib.ytk_parse(
+        data, len(data), x_delim.encode(), y_delim.encode(),
+        features_delim.encode(), feature_name_val_delim.encode(),
+        n_threads, divisor, remainder,
+    )
+    try:
+        n = lib.ytk_n_rows(h)
+        nnz = lib.ytk_nnz(h)
+        nlab = lib.ytk_n_label_vals(h)
+        nnames = lib.ytk_n_names(h)
+        nbytes = lib.ytk_name_bytes(h)
+        weights = np.empty(n, np.float32)
+        label_ptr = np.empty(n + 1, np.int64)
+        labels = np.empty(nlab, np.float32)
+        row_ptr = np.empty(n + 1, np.int64)
+        feat_ids = np.empty(nnz, np.int32)
+        feat_vals = np.empty(nnz, np.float32)
+        name_buf = ctypes.create_string_buffer(max(int(nbytes), 1))
+        lib.ytk_fill(
+            h,
+            weights.ctypes.data_as(ctypes.c_void_p),
+            label_ptr.ctypes.data_as(ctypes.c_void_p),
+            labels.ctypes.data_as(ctypes.c_void_p),
+            row_ptr.ctypes.data_as(ctypes.c_void_p),
+            feat_ids.ctypes.data_as(ctypes.c_void_p),
+            feat_vals.ctypes.data_as(ctypes.c_void_p),
+            ctypes.cast(name_buf, ctypes.c_void_p),
+        )
+        names = (
+            name_buf.raw[: int(nbytes)].decode("utf-8").split("\n")[:-1]
+            if nnames else []
+        )
+        return ParsedBlock(
+            weights=weights, label_ptr=label_ptr, labels=labels,
+            row_ptr=row_ptr, feat_ids=feat_ids, feat_vals=feat_vals,
+            names=names, n_errors=int(lib.ytk_n_errors(h)),
+        )
+    finally:
+        lib.ytk_free(h)
+
+
+def read_paths_bytes(fs, paths: Sequence[str]) -> bytes:
+    """All files (sorted-path order, like fs.read_lines) as one newline-
+    terminated byte buffer — the native parser's input."""
+    chunks: List[bytes] = []
+    for p in sorted(fs.recur_get_paths(paths)):
+        with fs.open(p, "rb") as f:
+            b = f.read()
+        if b and not b.endswith(b"\n"):
+            b += b"\n"
+        chunks.append(b)
+    return b"".join(chunks)
+
+
+def supports_delims(delim) -> bool:
+    """The C parser handles multi-char x_delim but single-char y/features/
+    name-val delims; other configs use the python path."""
+    return (
+        len(delim.x_delim) >= 1
+        and len(delim.y_delim) == 1
+        and len(delim.features_delim) == 1
+        and len(delim.feature_name_val_delim) == 1
+    )

@@ -227,20 +227,30 @@ class IngestResult:
     y_weight_stat: Optional[np.ndarray] = None
 
 
-def shard_read_lines(fs, data_params, paths):
-    """This process's line shard (reference: DataFlow.java:391-410 —
-    assigned mode reads everything; unassigned splits by files_avg or
-    line-modulo lines_avg across processes)."""
+def shard_plan(fs, data_params, paths) -> Tuple[Sequence[str], int, int]:
+    """This process's read plan: (paths, divisor, remainder). The single
+    source of truth for the assigned / files_avg / lines_avg dispatch
+    (reference: DataFlow.java:391-410) — shared by the python line reader
+    and the native parser so both always read the same shard."""
     import jax
 
     n_proc = jax.process_count()
     proc = jax.process_index()
     if data_params.assigned or n_proc == 1:
-        return fs.read_lines(paths)
+        return paths, 1, 0
     if data_params.unassigned_mode == "files_avg":
         files = sorted(fs.recur_get_paths(paths))
-        return fs.read_lines(files[proc::n_proc])
-    return fs.select_read_lines(paths, n_proc, proc)
+        return files[proc::n_proc], 1, 0
+    return paths, n_proc, proc
+
+
+def shard_read_lines(fs, data_params, paths):
+    """This process's line shard (assigned mode reads everything; unassigned
+    splits by files_avg or line-modulo lines_avg across processes)."""
+    paths, divisor, remainder = shard_plan(fs, data_params, paths)
+    if divisor == 1:
+        return fs.read_lines(paths)
+    return fs.select_read_lines(paths, divisor, remainder)
 
 
 class DataIngest:
