@@ -1,0 +1,52 @@
+"""CLI restart loop: on failure, retry with model.continue_train=true so the
+run resumes from the last checkpoint dump (reference: the
+bin/hadoop_optimizer.sh:53-80 max_hadoop_restart loop + checkpoint resume)."""
+
+import pytest
+
+from ytklearn_tpu.cli import train_main
+from ytklearn_tpu.train import HoagTrainer
+
+REF = "/root/reference"
+
+
+@pytest.fixture
+def linear_args(tmp_path):
+    import shutil
+
+    train_ytk = tmp_path / "a.train.ytk"
+    shutil.copy(f"{REF}/demo/data/ytklearn/agaricus.train.ytklearn", train_ytk)
+    return [
+        "linear",
+        f"{REF}/demo/linear/binary_classification/linear.conf",
+        "--set", f"data.train.data_path={train_ytk}",
+        "--set", "data.test.data_path=",
+        "--set", f"model.data_path={tmp_path / 'model'}",
+        "--set", "optimization.line_search.lbfgs.convergence.max_iter=3",
+    ]
+
+
+def test_restart_resumes_after_failure(linear_args, monkeypatch):
+    calls = []
+    orig = HoagTrainer.train
+
+    def flaky(self, *a, **kw):
+        calls.append(bool(self.params.model.continue_train))
+        if len(calls) == 1:
+            raise RuntimeError("injected mid-train failure")
+        return orig(self, *a, **kw)
+
+    monkeypatch.setattr(HoagTrainer, "train", flaky)
+    rc = train_main(linear_args + ["--max-restarts", "2"])
+    assert rc == 0
+    # first attempt ran with the config as given; the retry forced resume
+    assert calls == [False, True]
+
+
+def test_no_restart_reraises(linear_args, monkeypatch):
+    def always_fail(self, *a, **kw):
+        raise RuntimeError("injected failure")
+
+    monkeypatch.setattr(HoagTrainer, "train", always_fail)
+    with pytest.raises(RuntimeError, match="injected"):
+        train_main(linear_args)

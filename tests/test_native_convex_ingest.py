@@ -1,0 +1,187 @@
+"""Columnar fast-path ingest (DataIngest._load_fast) parity with the python
+path across the convex-model pipeline: dict build, filtering, transforms,
+feature hashing, y-sampling rng consumption, FFM field maps, label stats."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from ytklearn_tpu.config.params import CommonParams
+from ytklearn_tpu.io import native
+from ytklearn_tpu.io.reader import DataIngest
+
+pytestmark = pytest.mark.skipif(
+    not native.native_available(), reason="native parser unavailable"
+)
+
+
+def _write(tmp_path, name, text):
+    p = tmp_path / name
+    p.write_text(text)
+    return str(p)
+
+
+def _params(tmp_path, train, test=None, **kw):
+    p = CommonParams()
+    p.data.train_paths = [train]
+    p.data.test_paths = [test] if test else []
+    p.data.train_max_error_tol = kw.pop("tol", 10)
+    p.data.test_max_error_tol = 10
+    p.model.data_path = str(tmp_path / "model")
+    p.model.need_bias = kw.pop("need_bias", True)
+    for k, v in kw.items():
+        parts = k.split("__")
+        obj = p
+        for part in parts[:-1]:
+            obj = getattr(obj, part)
+        setattr(obj, parts[-1], v)
+    return p
+
+
+TRAIN = (
+    "1###1###a:1.5,b:2,c:0.5\n"
+    "2###0###b:1,d:4\n"
+    "junk\n"
+    "1###1###a:-1,c:3,c:7\n"  # duplicate name in row
+    "1###0###d:2.5,e:1\n"
+    "0.5###1###a:2,b:0.25\n"
+)
+TEST = "1###1###a:1,zz:9,b:2\n1###0###d:1\n"
+
+
+def _both(tmp_path, params, **ingest_kw):
+    a = DataIngest(dataclasses.replace(params), **ingest_kw)._load_fast()
+    b = DataIngest(dataclasses.replace(params), **ingest_kw)._load_python()
+    return a, b
+
+
+def _assert_result_equal(a, b, exact=True):
+    assert a.feature_map == b.feature_map
+    assert a.train.n_real == b.train.n_real
+    assert a.train.dim == b.train.dim
+    cmp = np.testing.assert_array_equal if exact else (
+        lambda x, y: np.testing.assert_allclose(x, y, rtol=1e-5, atol=1e-6)
+    )
+    np.testing.assert_array_equal(a.train.idx, b.train.idx)
+    cmp(a.train.val, b.train.val)
+    np.testing.assert_array_equal(a.train.y, b.train.y)
+    np.testing.assert_array_equal(a.train.weight, b.train.weight)
+    np.testing.assert_array_equal(a.y_real_stat, b.y_real_stat)
+    np.testing.assert_allclose(a.y_weight_stat, b.y_weight_stat, rtol=1e-6)
+    if a.test is not None or b.test is not None:
+        np.testing.assert_array_equal(a.test.idx, b.test.idx)
+        cmp(a.test.val, b.test.val)
+        np.testing.assert_array_equal(a.test.y, b.test.y)
+    if a.train.field is not None or b.train.field is not None:
+        np.testing.assert_array_equal(a.train.field, b.train.field)
+
+
+def test_basic_parity(tmp_path):
+    tr = _write(tmp_path, "tr.txt", TRAIN)
+    te = _write(tmp_path, "te.txt", TEST)
+    a, b = _both(tmp_path, _params(tmp_path, tr, te))
+    _assert_result_equal(a, b)
+
+
+def test_no_bias_and_filter_threshold(tmp_path):
+    tr = _write(tmp_path, "tr.txt", TRAIN)
+    params = _params(tmp_path, tr, need_bias=False)
+    params.feature.filter_threshold = 2
+    a, b = _both(tmp_path, params)
+    _assert_result_equal(a, b)
+    assert "e" not in a.feature_map  # appears once < threshold
+
+
+def test_transform_standardization(tmp_path):
+    tr = _write(tmp_path, "tr.txt", TRAIN)
+    params = _params(tmp_path, tr)
+    params.feature.transform.switch_on = True
+    params.feature.transform.mode = "standardization"
+    a, b = _both(tmp_path, params)
+    _assert_result_equal(a, b, exact=False)
+    assert a.transform_nodes.keys() == b.transform_nodes.keys()
+    for k in a.transform_nodes:
+        np.testing.assert_allclose(
+            a.transform_nodes[k].mean, b.transform_nodes[k].mean, rtol=1e-5
+        )
+
+
+def test_transform_scale_range(tmp_path):
+    tr = _write(tmp_path, "tr.txt", TRAIN)
+    params = _params(tmp_path, tr)
+    params.feature.transform.switch_on = True
+    params.feature.transform.mode = "scale_range"
+    a, b = _both(tmp_path, params)
+    _assert_result_equal(a, b, exact=False)
+
+
+def test_feature_hash_parity(tmp_path):
+    tr = _write(tmp_path, "tr.txt", TRAIN)
+    params = _params(tmp_path, tr)
+    params.feature.feature_hash.need_feature_hash = True
+    params.feature.feature_hash.bucket_size = 8
+    params.feature.feature_hash.seed = 17
+    a, b = _both(tmp_path, params)
+    _assert_result_equal(a, b, exact=False)
+    assert all(n.startswith("hash_") or n == "_bias_" or n == "bias"
+               for n in a.feature_map if n != list(a.feature_map)[0])
+
+
+def test_y_sampling_rng_parity(tmp_path):
+    lines = [f"1###{i % 2}###a:{i},b:{i * 2}" for i in range(200)]
+    tr = _write(tmp_path, "tr.txt", "\n".join(lines) + "\n")
+    params = _params(tmp_path, tr)
+    params.data.y_sampling = [("0", 0.5), ("1", 2.0)]
+    a, b = _both(tmp_path, params)
+    _assert_result_equal(a, b)  # identical rng draws -> identical kept rows
+
+
+def test_multiclass_labels(tmp_path):
+    text = (
+        "1###2###a:1\n"
+        "1###0,0,1###b:1\n"
+        "1###7###a:1\n"  # out of range -> error
+        "1###-1###b:2\n"  # wraps to class 2 (python list indexing)
+        "1###0,1###a:3\n"  # wrong width -> error
+    )
+    tr = _write(tmp_path, "tr.txt", text)
+    a, b = _both(tmp_path, _params(tmp_path, tr), n_labels=3)
+    _assert_result_equal(a, b)
+    assert a.train.y.shape == (3, 3)
+
+
+def test_ffm_field_map(tmp_path):
+    text = "1###1###f1^a:1,f2^b:2,zz^c:3\n1###0###f1^d:4\n"
+    tr = _write(tmp_path, "tr.txt", text)
+    params = _params(tmp_path, tr)
+    params.data.delim.field_delim = "^"
+    fm = {"f1": 0, "f2": 1}
+    a, b = _both(tmp_path, params, field_map=fm)
+    _assert_result_equal(a, b)
+    assert a.train.field is not None
+
+
+def test_error_tol_exceeded(tmp_path):
+    tr = _write(tmp_path, "tr.txt", TRAIN, )
+    params = _params(tmp_path, tr, tol=0)
+    with pytest.raises(Exception):
+        DataIngest(dataclasses.replace(params))._load_fast()
+    with pytest.raises(Exception):
+        DataIngest(dataclasses.replace(params))._load_python()
+
+
+def test_dispatch_uses_fast_path(tmp_path, monkeypatch):
+    tr = _write(tmp_path, "tr.txt", TRAIN)
+    params = _params(tmp_path, tr)
+    ing = DataIngest(params)
+    called = {}
+    orig = ing._load_fast
+
+    def spy():
+        called["fast"] = True
+        return orig()
+
+    monkeypatch.setattr(ing, "_load_fast", spy)
+    ing.load()
+    assert called.get("fast")

@@ -93,6 +93,10 @@ def train_main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--transform", action="store_true", help="enable the python line-transform hook")
     ap.add_argument("--transform-script", default="bin/transform.py")
     ap.add_argument("--devices", type=int, default=0, help="mesh size (default: all local devices)")
+    ap.add_argument("--max-restarts", type=int, default=0,
+                    help="on failure, retry with model.continue_train=true to "
+                    "resume from the last checkpoint dump (reference: the "
+                    "bin/hadoop_optimizer.sh:53-80 restart loop)")
     ap.add_argument("--set", action="append", dest="sets", metavar="KEY=VALUE",
                     help="config override, repeatable")
     ap.add_argument("--verbose", action="store_true")
@@ -106,15 +110,40 @@ def train_main(argv: Optional[List[str]] = None) -> int:
     hook = _load_hook(args.transform, args.transform_script)
     name = args.model_name
 
+    log = logging.getLogger("ytklearn_tpu.cli")
+    restarts = max(args.max_restarts, 0)
+    for attempt in range(restarts + 1):
+        try:
+            return _train_once(name, cfg, mesh, hook)
+        except KeyboardInterrupt:
+            raise
+        except Exception:
+            if attempt >= restarts:
+                raise
+            log.exception(
+                "training attempt %d/%d failed; restarting with "
+                "model.continue_train=true",
+                attempt + 1, restarts + 1,
+            )
+            # resume from the last periodic dump (fail-fast + restart is the
+            # reference's recovery model: checkpoint-as-model + relaunch)
+            cfg = hocon.set_path(cfg, "model.continue_train", True)
+    return 1  # unreachable
+
+
+def _train_once(name: str, cfg: dict, mesh, hook) -> int:
+    from .io.fs import create_filesystem
+
+    fs = create_filesystem(str(cfg.get("fs_scheme", "local")))
     if name == "gbdt":
         from .config.params import GBDTParams
         from .gbdt.data import GBDTIngest
         from .gbdt.trainer import GBDTTrainer
 
         p = GBDTParams.from_config(cfg)
-        ingest = GBDTIngest(p, transform_hook=hook)
+        ingest = GBDTIngest(p, fs=fs, transform_hook=hook)
         train, test = ingest.load()
-        res = GBDTTrainer(p, mesh=mesh).train(train=train, test=test)
+        res = GBDTTrainer(p, mesh=mesh, fs=fs).train(train=train, test=test)
         print(json.dumps({
             "model": name,
             "trees": len(res.model.trees),
@@ -132,8 +161,8 @@ def train_main(argv: Optional[List[str]] = None) -> int:
         from .boost import GBSTTrainer
         from .io.reader import DataIngest
 
-        ingest = DataIngest(p, transform_hook=hook).load()
-        res = GBSTTrainer(p, name, mesh=mesh).train(ingest=ingest)
+        ingest = DataIngest(p, fs=fs, transform_hook=hook).load()
+        res = GBSTTrainer(p, name, mesh=mesh, fs=fs).train(ingest=ingest)
         print(json.dumps({
             "model": name,
             "trees": res.n_trees,
@@ -146,7 +175,7 @@ def train_main(argv: Optional[List[str]] = None) -> int:
 
     from .train import HoagTrainer
 
-    res = HoagTrainer(p, name, mesh=mesh, transform_hook=hook).train()
+    res = HoagTrainer(p, name, mesh=mesh, fs=fs, transform_hook=hook).train()
     print(json.dumps({
         "model": name,
         "n_iter": res.n_iter,

@@ -130,19 +130,11 @@ class GBDTIngest:
             d.feature_name_val_delim, divisor=divisor, remainder=remainder,
         )
 
-        # label-shape validation (python path: errors counted per bad row)
-        widths = np.diff(blk.label_ptr)
+        # label expansion + shape validation (python path: errors per bad row)
         n_errors = blk.n_errors
-        if self.K > 1:
-            bad = (widths != 1) & (widths != self.K)
-            first = blk.labels[blk.label_ptr[:-1]]
-            is_cls = widths == 1
-            # python-path semantics: int() truncates toward zero; a negative
-            # in-range index wraps (list indexing); out of [-K, K-1] raises
-            cls = np.trunc(first).astype(np.int64)
-            bad |= is_cls & ((cls >= self.K) | (cls < -self.K))
-        else:
-            bad = np.zeros(blk.n, bool)
+        bad, y_all = native.expand_labels_columnar(
+            blk.label_ptr, blk.labels, blk.n, self.K
+        )
         n_errors += int(bad.sum())
         keep = ~bad
 
@@ -240,22 +232,7 @@ class GBDTIngest:
         last = len(flat) - 1 - np.unique(flat[::-1], return_index=True)[1]
         X[r[last], c[last]] = v[last]
         weight = blk.weights[keep].astype(np.float32)
-        if self.K > 1:
-            y = np.zeros((n, self.K), np.float32)
-            kidx = np.where(keep)[0]
-            wk = widths[kidx]
-            # explicit K-vector rows
-            full = wk == self.K
-            if full.any():
-                src = blk.label_ptr[kidx[full]][:, None] + np.arange(self.K)
-                y[np.where(full)[0]] = blk.labels[src]
-            one = ~full
-            if one.any():
-                cls_k = np.trunc(blk.labels[blk.label_ptr[kidx[one]]]).astype(np.int64)
-                cls_k = np.where(cls_k < 0, cls_k + self.K, cls_k)
-                y[np.where(one)[0], cls_k] = 1.0
-        else:
-            y = blk.labels[blk.label_ptr[:-1]][keep].astype(np.float32)
+        y = y_all[keep]
         return GBDTData(X=X, y=y, weight=weight, n_real=n,
                         feature_names=self._names_from_fmap(fmap))
 

@@ -206,6 +206,38 @@ def read_paths_bytes(fs, paths: Sequence[str]) -> bytes:
     return b"".join(chunks)
 
 
+def expand_labels_columnar(
+    label_ptr: np.ndarray, labels: np.ndarray, n: int, K: int
+):
+    """Vectorized python-float() label expansion shared by the GBDT and
+    convex fast paths: width-K vectors pass through; width-1 is an int()-
+    truncated class index where a negative in-range value wraps (python
+    list indexing) and anything outside [-K, K-1] is an error line.
+
+    Returns (bad, y): bad (n,) bool error-row mask; y (n,) f32 for K==1
+    (first label, extras ignored) or (n, K) f32 one-hot/verbatim, zero
+    rows where bad."""
+    firsts = labels[label_ptr[:-1]] if n else np.zeros(0, np.float32)
+    if K == 1:
+        return np.zeros(n, bool), firsts.astype(np.float32)
+    widths = np.diff(label_ptr)
+    bad = (widths != 1) & (widths != K)
+    cls = np.trunc(firsts).astype(np.int64)
+    is_cls = widths == 1
+    bad |= is_cls & ((cls >= K) | (cls < -K))
+    y = np.zeros((n, K), np.float32)
+    fullm = ~bad & (widths == K)
+    if fullm.any():
+        src = label_ptr[:-1][fullm][:, None] + np.arange(K)
+        y[fullm] = labels[src]
+    onem = ~bad & is_cls
+    if onem.any():
+        ck = cls[onem]
+        ck = np.where(ck < 0, ck + K, ck)
+        y[np.where(onem)[0], ck] = 1.0
+    return bad, y
+
+
 def supports_delims(delim) -> bool:
     """The C parser handles multi-char x_delim but single-char y/features/
     name-val delims; other configs use the python path."""

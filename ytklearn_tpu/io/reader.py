@@ -211,6 +211,27 @@ class SparseDataset:
         )
 
 
+@dataclass
+class _Cols:
+    """Columnar rows from the native parser (post label-expansion, hashing,
+    y-sampling): the fast-path replacement for List[ParsedLine]."""
+
+    weight: np.ndarray  # (n,) f32
+    y: np.ndarray  # (n,) or (n, K) f32
+    occ_row: np.ndarray  # (nnz,) i64 row of each feature occurrence
+    occ_name: np.ndarray  # (nnz,) i64 -> names
+    occ_val: np.ndarray  # (nnz,) f64
+    names: List[str]
+
+
+def _counts_from_rows(rows: Sequence[ParsedLine]) -> Dict[str, int]:
+    counts: Dict[str, int] = {}
+    for r in rows:
+        for name, _ in r.feats:
+            counts[name] = counts.get(name, 0) + 1
+    return counts
+
+
 # ---------------------------------------------------------------------------
 # The ingest driver (DataFlow equivalent)
 # ---------------------------------------------------------------------------
@@ -352,11 +373,12 @@ class DataIngest:
     def build_feature_map(self, rows: Sequence[ParsedLine]) -> Dict[str, int]:
         """Count -> filter(threshold) -> sorted names -> indices, bias at 0
         (reference: DataFlow.reduceFeature:294)."""
+        return self.finalize_feature_map(_counts_from_rows(rows))
+
+    def finalize_feature_map(self, counts: Dict[str, int]) -> Dict[str, int]:
+        """Shared dict finalization: cross-process count merge, threshold
+        filter, sorted names, bias at 0."""
         p = self.params
-        counts: Dict[str, int] = {}
-        for r in rows:
-            for name, _ in r.feats:
-                counts[name] = counts.get(name, 0) + 1
         counts = self._merge_counts(counts)
         thr = p.feature.filter_threshold
         names = sorted(n for n, c in counts.items() if c >= thr)
@@ -405,9 +427,7 @@ class DataIngest:
     def compute_transform_nodes(
         self, rows: Sequence[ParsedLine], fmap: Dict[str, int]
     ) -> Dict[int, TransformNode]:
-        p = self.params
-        t = p.feature.transform
-        if not t.switch_on:
+        if not self.params.feature.transform.switch_on:
             return {}
         stats: Dict[str, FeatureStat] = {}
         for r in rows:
@@ -416,6 +436,14 @@ class DataIngest:
                 if s is None:
                     stats[name] = s = FeatureStat()
                 s.update(v)
+        return self.nodes_from_stats(stats, fmap)
+
+    def nodes_from_stats(
+        self, stats: Dict[str, FeatureStat], fmap: Dict[str, int]
+    ) -> Dict[int, TransformNode]:
+        """Cross-process stat merge + include/exclude selection -> nodes."""
+        p = self.params
+        t = p.feature.transform
         # multi-host merge
         from ..parallel.collectives import host_allgather_objects
 
@@ -521,13 +549,35 @@ class DataIngest:
 
     # -- the whole flow ---------------------------------------------------
 
-    def load(self) -> IngestResult:
-        """The loadFlow equivalent (reference: dataflow/DataFlow.java:468)."""
+    def _resolve_feature_map(self, counts_fn) -> Dict[str, int]:
+        """The dict branch shared by both load paths: load when just_evaluate
+        / need_dict / continue_train finds a sidecar, else build from counts."""
         p = self.params
-        import jax
+        model_dict_path = p.model.data_path + "_dict"
+        if p.loss.just_evaluate and self.fs.exists(model_dict_path):
+            return self.load_feature_map([model_dict_path])
+        if p.model.need_dict and p.model.dict_path:
+            return self.load_feature_map([p.model.dict_path])
+        if p.model.continue_train and self.fs.exists(model_dict_path):
+            return self.load_feature_map([model_dict_path])
+        return self.finalize_feature_map(counts_fn())
 
-        n_proc = jax.process_count()
-        proc = jax.process_index()
+    def load(self) -> IngestResult:
+        """The loadFlow equivalent (reference: dataflow/DataFlow.java:468).
+
+        Dispatches to the columnar native-parser path when available (exact
+        parity with the python path, tests/test_native_ingest.py); the python
+        path remains for transform-hook / exotic-delimiter configs."""
+        from . import native
+
+        if (self.transform_hook is None
+                and native.native_available()
+                and native.supports_delims(self.params.data.delim)):
+            return self._load_fast()
+        return self._load_python()
+
+    def _load_python(self) -> IngestResult:
+        p = self.params
 
         def read(paths: Sequence[str]) -> Iterator[str]:
             return shard_read_lines(self.fs, p.data, paths)
@@ -535,18 +585,7 @@ class DataIngest:
         train_rows = self.parse_rows(
             read(p.data.train_paths), p.data.train_max_error_tol, is_train=True
         )
-
-        # dict: load when need_dict or continue_train with an existing sidecar
-        model_dict_path = p.model.data_path + "_dict"
-        if p.loss.just_evaluate and self.fs.exists(model_dict_path):
-            fmap = self.load_feature_map([model_dict_path])
-        elif p.model.need_dict and p.model.dict_path:
-            fmap = self.load_feature_map([p.model.dict_path])
-        elif p.model.continue_train and self.fs.exists(model_dict_path):
-            fmap = self.load_feature_map([model_dict_path])
-        else:
-            fmap = self.build_feature_map(train_rows)
-
+        fmap = self._resolve_feature_map(lambda: _counts_from_rows(train_rows))
         nodes = self.compute_transform_nodes(train_rows, fmap)
         if nodes:
             self.write_transform_sidecar(nodes, fmap)
@@ -564,13 +603,253 @@ class DataIngest:
         y_real = np.zeros(K, np.int64)
         y_weight = np.zeros(K, np.float64)
         for r in train_rows:
-            li = r.labels.index(1.0) if len(r.labels) > 1 else int(r.labels[0])
+            if len(r.labels) > 1:
+                if 1.0 not in r.labels:
+                    continue  # soft K-vector label: no class slot to count
+                li = r.labels.index(1.0)
+            else:
+                li = int(r.labels[0])
             if 0 <= li < K:
                 y_real[li] += 1
                 y_weight[li] += r.weight
         return IngestResult(
             train=train,
             test=test,
+            feature_map=fmap,
+            transform_nodes=nodes,
+            y_real_stat=y_real,
+            y_weight_stat=y_weight,
+        )
+
+    # -- columnar fast path (native parser) -------------------------------
+
+    def _parse_cols(self, paths, max_error_tol: int, is_train: bool) -> "_Cols":
+        """Native parse + vectorized label expansion / hashing / y-sampling.
+        Row and occurrence arrays come back in input order, matching the
+        python path row-for-row (errors, dict order, rng consumption)."""
+        from . import native
+
+        p = self.params
+        d = p.data.delim
+        paths2, divisor, remainder = shard_plan(self.fs, p.data, paths)
+        buf = native.read_paths_bytes(self.fs, paths2)
+        blk = native.parse_block(
+            buf, d.x_delim, d.y_delim, d.features_delim,
+            d.feature_name_val_delim, divisor=divisor, remainder=remainder,
+        )
+        n_errors = blk.n_errors
+        n = blk.n
+        K = self.n_labels
+        bad, y = native.expand_labels_columnar(blk.label_ptr, blk.labels, n, K)
+
+        occ_row = np.repeat(np.arange(n), np.diff(blk.row_ptr))
+        occ_name = blk.feat_ids.astype(np.int64)
+        occ_val = blk.feat_vals.astype(np.float64)
+        names: List[str] = blk.names
+
+        if self.hash is not None and len(names):
+            # hash per unique raw name, then per-row dedup-sum of signed
+            # values in first-occurrence order (FeatureHash.hash_features)
+            uniq: Dict[str, int] = {}
+            hid_of = np.empty(len(names), np.int64)
+            sign_of = np.empty(len(names), np.float64)
+            for i, nm in enumerate(names):
+                hn, sg = self.hash.hash_name(nm)
+                hid_of[i] = uniq.setdefault(hn, len(uniq))
+                sign_of[i] = sg
+            signed = occ_val * sign_of[occ_name]
+            hids = hid_of[occ_name]
+            key = occ_row * np.int64(len(uniq)) + hids
+            _, first_ix, inv = np.unique(key, return_index=True, return_inverse=True)
+            sums = np.bincount(inv, weights=signed)
+            order = np.argsort(first_ix, kind="stable")
+            sel = first_ix[order]
+            occ_row = occ_row[sel]
+            occ_name = hids[sel]
+            occ_val = sums[order]
+            names = list(uniq)
+
+        keep = ~bad
+        weight = blk.weights.astype(np.float64)
+        if is_train and p.data.y_sampling:
+            # label-dependent subsampling with inverse-probability weight
+            # correction (CoreData.yExtract). The host loop preserves the
+            # python path's rng consumption order exactly: one rng.random()
+            # per kept row whose label has a configured rate.
+            ys = {k: float(v) for k, v in dict(p.data.y_sampling).items()}
+            if K == 1:
+                lidx = np.trunc(y).astype(np.int64)
+                has1 = np.ones(n, bool)
+            else:
+                has1 = (y == 1.0).any(axis=1)
+                lidx = np.argmax(y == 1.0, axis=1)
+                # a K-vector label without an exact 1.0 cannot be sampled —
+                # error line, like the python path's labels.index(1.0) raise
+                newly_bad = keep & ~has1
+                n_errors += int(newly_bad.sum())
+                keep &= has1
+            for i in np.flatnonzero(keep):
+                rate = ys.get(str(int(lidx[i])))
+                if rate is None:
+                    continue
+                weight[i] *= (1.0 / rate) if rate <= 1.0 else rate
+                if self.rng.random() > rate:
+                    keep[i] = False
+
+        if n_errors > max_error_tol:
+            raise ValueError(
+                f"data error lines ({n_errors}) exceed max_error_tol "
+                f"({max_error_tol})"
+            )
+
+        new_row = np.cumsum(keep) - 1
+        occ_keep = keep[occ_row]
+        return _Cols(
+            weight=weight[keep].astype(np.float32),
+            y=y[keep],
+            occ_row=new_row[occ_row[occ_keep]],
+            occ_name=occ_name[occ_keep],
+            occ_val=occ_val[occ_keep],
+            names=names,
+        )
+
+    def _cols_to_dataset(
+        self,
+        cols: "_Cols",
+        fmap: Dict[str, int],
+        nodes: Optional[Dict[int, TransformNode]] = None,
+    ) -> SparseDataset:
+        """Vectorized to_dataset: dict/field filtering, value transform,
+        padded-ELL assembly."""
+        p = self.params
+        nodes = nodes or {}
+        need_bias = p.model.need_bias
+        n = len(cols.weight)
+        gi_of = np.asarray([fmap.get(nm, -1) for nm in cols.names], np.int64)
+        gi = gi_of[cols.occ_name] if len(cols.occ_name) else np.zeros(0, np.int64)
+        keep = gi >= 0
+        f = None
+        if self.field_map is not None:
+            fdelim = p.data.delim.field_delim
+            fid_of = np.asarray(
+                [self.field_map.get(nm.split(fdelim)[0], -1) for nm in cols.names],
+                np.int64,
+            )
+            f = fid_of[cols.occ_name] if len(cols.occ_name) else np.zeros(0, np.int64)
+            keep &= f >= 0
+            f = f[keep]
+        occ_row = cols.occ_row[keep]
+        gi = gi[keep]
+        val = cols.occ_val[keep].astype(np.float64)
+
+        if nodes:
+            dim = len(fmap)
+            has = np.zeros(dim, bool)
+            is_std = np.zeros(dim, bool)
+            mean = np.zeros(dim)
+            std = np.zeros(dim)
+            mn = np.zeros(dim)
+            mx = np.zeros(dim)
+            rmin = np.zeros(dim)
+            rmax = np.zeros(dim)
+            for g, node in nodes.items():
+                has[g] = True
+                is_std[g] = node.mode == "standardization"
+                mean[g], std[g] = node.mean, node.stdvar
+                mn[g], mx[g] = node.min, node.max
+                rmin[g], rmax[g] = node.range_min, node.range_max
+            h = has[gi]
+            stdv = std[gi]
+            std_ok = is_std[gi] & (stdv >= 1e-6)
+            val = np.where(h & std_ok, (val - mean[gi]) / np.where(stdv == 0, 1, stdv), val)
+            span = mx[gi] - mn[gi]
+            small = np.abs(span) < 1e-6
+            scaled = np.where(
+                small, 1.0,
+                rmin[gi] + (rmax[gi] - rmin[gi]) * (val - mn[gi]) / np.where(small, 1, span),
+            )
+            val = np.where(h & ~is_std[gi], scaled, val)
+
+        cnt = np.bincount(occ_row, minlength=n) if n else np.zeros(0, np.int64)
+        delta = 1 if need_bias else 0
+        width = max((int(cnt.max()) if n and len(cnt) else 0) + delta, 1)
+        idx = np.zeros((n, width), np.int32)
+        vmat = np.zeros((n, width), np.float32)
+        fmat = np.zeros((n, width), np.int32) if self.field_map is not None else None
+        if need_bias and n:
+            vmat[:, 0] = 1.0  # bias index 0, field 0 (FFMModelDataFlow)
+        rp = np.zeros(n + 1, np.int64)
+        np.cumsum(cnt, out=rp[1:])
+        j = np.arange(len(occ_row)) - rp[occ_row] + delta
+        idx[occ_row, j] = gi
+        vmat[occ_row, j] = val
+        if fmat is not None:
+            fmat[occ_row, j] = f
+        K = self.n_labels
+        y = cols.y if K > 1 else cols.y.reshape(-1)
+        return SparseDataset(
+            idx, vmat, y.astype(np.float32), cols.weight, n_real=n,
+            dim=len(fmap), field=fmat,
+        )
+
+    def _load_fast(self) -> IngestResult:
+        """Columnar loadFlow over the native parser — same pipeline, same
+        results as _load_python, numpy-vectorized end to end."""
+        p = self.params
+        train = self._parse_cols(
+            p.data.train_paths, p.data.train_max_error_tol, is_train=True
+        )
+
+        def counts() -> Dict[str, int]:
+            c = np.bincount(train.occ_name, minlength=len(train.names))
+            return {nm: int(c[i]) for i, nm in enumerate(train.names) if c[i] > 0}
+
+        fmap = self._resolve_feature_map(counts)
+
+        nodes: Dict[int, TransformNode] = {}
+        if p.feature.transform.switch_on:
+            nn = len(train.names)
+            cnt = np.bincount(train.occ_name, minlength=nn).astype(np.int64)
+            s1 = np.bincount(train.occ_name, weights=train.occ_val, minlength=nn)
+            s2 = np.bincount(train.occ_name, weights=train.occ_val**2, minlength=nn)
+            mn = np.full(nn, math.inf)
+            mx = np.full(nn, -math.inf)
+            if len(train.occ_name):
+                np.minimum.at(mn, train.occ_name, train.occ_val)
+                np.maximum.at(mx, train.occ_name, train.occ_val)
+            stats = {
+                nm: FeatureStat(cnt=int(cnt[i]), sum=float(s1[i]),
+                                sum2=float(s2[i]), max=float(mx[i]), min=float(mn[i]))
+                for i, nm in enumerate(train.names) if cnt[i] > 0
+            }
+            nodes = self.nodes_from_stats(stats, fmap)
+            if nodes:
+                self.write_transform_sidecar(nodes, fmap)
+
+        train_ds = self._cols_to_dataset(train, fmap, nodes)
+        test_ds = None
+        if p.data.test_paths:
+            test = self._parse_cols(
+                p.data.test_paths, p.data.test_max_error_tol, is_train=False
+            )
+            test_ds = self._cols_to_dataset(test, fmap, nodes)
+
+        # global label stats (CoreData.globalSync y stats)
+        K = max(self.n_labels, 2)
+        y_real = np.zeros(K, np.int64)
+        y_weight = np.zeros(K, np.float64)
+        if self.n_labels == 1:
+            li = np.trunc(train.y).astype(np.int64)
+            valid = (li >= 0) & (li < K)
+        else:
+            has1 = (train.y == 1.0).any(axis=1)
+            li = np.argmax(train.y == 1.0, axis=1)
+            valid = has1 & (li >= 0) & (li < K)
+        np.add.at(y_real, li[valid], 1)
+        np.add.at(y_weight, li[valid], train.weight[valid].astype(np.float64))
+        return IngestResult(
+            train=train_ds,
+            test=test_ds,
             feature_map=fmap,
             transform_nodes=nodes,
             y_real_stat=y_real,
