@@ -116,6 +116,7 @@ def test_cli_train_gbdt_demo(tmp_path, capsys):
         "--set", f"data.train.data_path={train_ytk}",
         "--set", "data.test.data_path=",
         "--set", f"model.data_path={tmp_path / 'gbdt.model'}",
+        "--set", f"model.feature_importance_path={tmp_path / 'gbdt.fimp'}",
         "--set", "data.max_feature_dim=127",
         "--set", "optimization.round_num=3",
         "--set", "optimization.max_depth=4",
