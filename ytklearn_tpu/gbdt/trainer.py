@@ -153,11 +153,14 @@ class GBDTTrainer:
         self.gain_fn, self.node_value_fn = make_gain_fns(*cfg)
         self.K = params.num_tree_in_group
         if engine == "auto":
-            # LAD leaf refinement is host-side (TreeRefiner.java); the
-            # feature-parallel maker is a host-loop maker by design
+            # precise LAD leaf refinement (lad_refine_appr=false) is a
+            # host-side sort, so it rides the host engine; the approximate
+            # default runs inside the device engine's jitted round. The
+            # feature-parallel maker is a host-loop maker by design.
             engine = (
                 "host"
-                if (params.loss_function == "l1" and self.K == 1)
+                if (params.loss_function == "l1" and self.K == 1
+                    and not params.lad_refine_appr)
                 or params.tree_maker == "feature"
                 else "device"
             )
@@ -382,6 +385,18 @@ class GBDTTrainer:
         inst_rate = p.instance_sample_rate
         feat_rate = p.feature_sample_rate
         has_test = test is not None
+        # LAD leaf refinement on device: the approximate quantile mode
+        # (reference: TreeRefiner.java GK-sketch path, lad_refine_appr=true
+        # default) as a rank-grid weighted median — exact when the grid
+        # covers every row (n <= _LAD_Q)
+        refine_lad = loss_fn.name == "l1" and K == 1
+        if refine_lad and not p.lad_refine_appr:
+            log.warning(
+                "lad_refine_appr=false requests the precise sort-based "
+                "refine, which only the host engine implements; the device "
+                "engine uses the approximate rank-grid refine instead "
+                "(pass engine='host' or leave engine='auto' for precise)"
+            )
         # big arrays ride as explicit args (closure capture would bake them
         # into the program as constants); test arrays fold into `data`
         data = (bins_t, y, weight, real_mask) + (
@@ -412,6 +427,10 @@ class GBDTTrainer:
                 g = (gs[:, grp] if K > 1 else gs) * weight
                 h = (hs[:, grp] if K > 1 else hs) * weight
                 tr, pos, aux_pos = grow(bins_t, include, g, h, fmask, aux=aux_bins)
+                if refine_lad:
+                    tr = _lad_refine_device(
+                        tr, pos, y, scores, weight, real_mask, p.learning_rate
+                    )
                 add = tr.leaf[pos]
                 if K > 1:
                     scores = scores.at[:, grp].add(add)
@@ -1113,6 +1132,43 @@ class GBDTTrainer:
                     self.loss.predict(scores_t), y_t, w_t
                 )
         return res
+
+
+_LAD_Q = 4096  # rank-grid resolution for device LAD refine
+
+
+def _lad_refine_device(tr, pos, y, scores, weight, real_mask, lr):
+    """Approximate LAD leaf refinement inside the device round: leaf value =
+    lr * weighted median of (y - score) over the leaf's rows, medians taken
+    on a global rank grid of _LAD_Q sorted residuals (reference:
+    optimizer/gbdt/TreeRefiner.java approximate GK mode; grid quantization
+    replaces the sketch — exact when n <= _LAD_Q). One sort + one
+    scatter-add per tree, no host round-trip."""
+    M = tr.leaf.shape[0]
+    Q = _LAD_Q
+    r = y - scores
+    valid = real_mask & (weight > 0)
+    big = jnp.float32(3.4e38)
+    rs = jnp.sort(jnp.where(valid, r, big))
+    nv = jnp.sum(valid.astype(jnp.int32))
+    # ranks = i*(nv-1)//(Q-1) in pure i32: i*base + i*rem//(Q-1) avoids the
+    # i*(nv-1) product overflowing at n > ~500k
+    i = jnp.arange(Q, dtype=jnp.int32)
+    span = jnp.maximum(nv - 1, 0)
+    base, rem = span // (Q - 1), span % (Q - 1)
+    ranks = i * base + (i * rem) // (Q - 1)
+    grid = rs[ranks]
+    qi = jnp.clip(jnp.searchsorted(grid, r, side="right") - 1, 0, Q - 1)
+    flat = pos * Q + qi
+    w = jnp.where(valid, weight, 0.0)
+    hist = jnp.zeros((M * Q,), jnp.float32).at[flat].add(w, mode="drop")
+    cw = jnp.cumsum(hist.reshape(M, Q), axis=1)
+    tot = cw[:, -1]
+    med = grid[jnp.argmax(cw >= 0.5 * tot[:, None], axis=1)]
+    is_leaf = (tr.feat == -1) & (jnp.arange(M) < tr.n_nodes)
+    return tr._replace(
+        leaf=jnp.where(is_leaf & (tot > 0), med * lr, tr.leaf)
+    )
 
 
 def _wavg_loss(loss, scores, y, weight):
