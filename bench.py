@@ -77,7 +77,9 @@ def main() -> None:
         approximate=[ApproximateSpec(type="sample_by_quantile", max_cnt=255)],
         model=ModelParams(data_path="/tmp/bench_gbdt_model", dump_freq=0),
     )
-    trainer = GBDTTrainer(params, engine="device")
+    # int8 histogram quantization (2x MXU rate) + wave 32: measured at this
+    # config vs bf16 — identical loss to the 3rd decimal, ~1.2x throughput
+    trainer = GBDTTrainer(params, engine="device", hist_precision="int8", wave=32)
     res = trainer.train(train=train)
     assert np.isfinite(res.train_loss) and res.train_loss < 0.65
     assert len(res.model.trees) == n_trees

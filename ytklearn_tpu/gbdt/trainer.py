@@ -176,23 +176,37 @@ class GBDTTrainer:
         self.use_bf16_hist = hist_precision != "f32" 
 
     def _put(self, arr):
+        """Row-shard dim 0. Multi-process: `arr` is this process's shard."""
         if self.mesh is None:
             return jax.device_put(arr)
-        return jax.device_put(arr, row_sharding(self.mesh))
+        from ..parallel.mesh import put_row_sharded
+
+        return put_row_sharded(arr, self.mesh)
 
     def _put_cols(self, arr):
-        """Shard the trailing (sample) axis of a transposed matrix."""
+        """Shard the trailing (sample) axis of a transposed matrix;
+        multi-process: `arr` carries this process's sample columns."""
         if self.mesh is None:
             return jax.device_put(arr)
-        from jax.sharding import NamedSharding, PartitionSpec
+        from ..parallel.mesh import put_col_sharded
 
-        return jax.device_put(
-            arr, NamedSharding(self.mesh, PartitionSpec(None, "data"))
-        )
+        return put_col_sharded(arr, self.mesh)
 
     def _cfg(self):
         p = self.params
         return (p.l1, p.l2, p.min_child_hessian_sum, p.max_abs_leaf_val)
+
+    def _shard_target(self, bins_np) -> Optional[int]:
+        """Multi-process: pad this process's rows to the cross-process
+        equalized target (bm-block divisible per device); single-process:
+        None = pad_inputs' default bm rounding."""
+        if jax.process_count() > 1 and self.mesh is not None:
+            from ..parallel.mesh import equal_row_target
+
+            return equal_row_target(
+                bins_np.shape[0], self.mesh, multiple=BM_DEFAULT
+            )
+        return None
 
     # -- entry ------------------------------------------------------------
 
@@ -203,6 +217,12 @@ class GBDTTrainer:
     ) -> GBDTResult:
         if self.engine == "device":
             return self._train_device(train, test)
+        if jax.process_count() > 1:
+            raise ValueError(
+                "multi-process GBDT training requires the device engine "
+                "(host-loop makers read per-row device state eagerly); got "
+                f"engine={self.engine!r}"
+            )
         return self._train_host(train, test)
 
     # ======================================================================
@@ -290,11 +310,14 @@ class GBDTTrainer:
             del X_t_dev, Xp
         else:
             bins_np = bin_matrix(train.X, bins)
-            bins_t_np, n_pad = pad_inputs(bins_np)
+            bins_t_np, n_pad = pad_inputs(bins_np, n_pad=self._shard_target(bins_np))
             bins_t = self._put_cols(bins_t_np)
         y = self._put(_pad0(train.y, n_pad))
         weight = self._put(_pad0(train.weight, n_pad))
         real_mask = self._put(np.arange(n_pad) < train.X.shape[0])
+        # global row count (the score/tree program shapes); n_pad stays the
+        # per-process shard length
+        n_score = n_pad * jax.process_count()
         ts["preprocess"] = time.time() - t0 - ts["load"]
         log.info(
             "load+preprocess %.1fs: %d rows, %d features, %d bins (pad %d)",
@@ -320,9 +343,9 @@ class GBDTTrainer:
             log.info("continue_train: loaded %d trees", len(model.trees))
 
         if K > 1:
-            scores = jnp.full((n_pad, K), base_np, jnp.float32)
+            scores = jnp.full((n_score, K), base_np, jnp.float32)
         else:
-            scores = jnp.full((n_pad,), float(base_np), jnp.float32)
+            scores = jnp.full((n_score,), float(base_np), jnp.float32)
 
         aux_bins = ()
         scores_t = None
@@ -341,14 +364,17 @@ class GBDTTrainer:
                 del Xt_t, bt_dev
             else:
                 bins_test_np = bin_matrix(test.X, bins)
-                bt_np, nt_pad = pad_inputs(bins_test_np)
+                bt_np, nt_pad = pad_inputs(
+                    bins_test_np, n_pad=self._shard_target(bins_test_np)
+                )
                 aux_bins = (self._put_cols(bt_np),)
             y_t = self._put(_pad0(test.y, nt_pad))
             w_t = self._put(_pad0(test.weight, nt_pad))
+            nt_score = nt_pad * jax.process_count()
             if K > 1:
-                scores_t = jnp.full((nt_pad, K), base_np, jnp.float32)
+                scores_t = jnp.full((nt_score, K), base_np, jnp.float32)
             else:
-                scores_t = jnp.full((nt_pad,), float(base_np), jnp.float32)
+                scores_t = jnp.full((nt_score,), float(base_np), jnp.float32)
 
         # continue_train score replay through the host trees
         if model.trees:
@@ -416,7 +442,7 @@ class GBDTTrainer:
             # reference's per-node sample counting
             include = real_mask
             if inst_rate < 1.0:
-                include &= jax.random.uniform(ki, (n_pad,)) <= inst_rate
+                include &= jax.random.uniform(ki, real_mask.shape) <= inst_rate
             if feat_rate < 1.0:
                 fmask = jax.random.uniform(kf, (F,)) <= feat_rate
                 fmask = fmask.at[0].set(fmask[0] | ~jnp.any(fmask))
@@ -1104,6 +1130,8 @@ class GBDTTrainer:
         return _assign_kernel(bins_dev, feat, slot, left, right, depth)
 
     def _dump_model(self, model: GBDTModel) -> None:
+        if jax.process_index() != 0:
+            return  # rank0-only dump (reference: GBDTOptimizer.java:434-437)
         p = self.params
         with self.fs.open(p.model.data_path, "w") as f:
             f.write(model.dumps(with_stats=True))

@@ -36,6 +36,45 @@ def make_mesh(n_devices: Optional[int] = None, axis_name: str = DATA_AXIS) -> Me
     return Mesh(np.asarray(devs), (axis_name,))
 
 
+def local_device_count(mesh: Mesh) -> int:
+    """This process's device count within the mesh."""
+    me = jax.process_index()
+    return sum(1 for d in mesh.devices.flat if d.process_index == me)
+
+
+def put_row_sharded(arr, mesh: Mesh):
+    """Row-shard dim 0 over the data axis. Single-process: a plain
+    device_put. Multi-process: `arr` is THIS process's row shard and the
+    global array is assembled from per-process shards (the TPU-native
+    replacement for the reference's per-worker CoreData ownership —
+    each worker's parsed rows become its device shard, no gather)."""
+    sh = row_sharding(mesh)
+    if jax.process_count() == 1:
+        return jax.device_put(arr, sh)
+    return jax.make_array_from_process_local_data(sh, arr)
+
+
+def put_col_sharded(arr, mesh: Mesh):
+    """Shard dim 1 (the sample axis of a transposed matrix) over data."""
+    sh = NamedSharding(mesh, P(None, DATA_AXIS))
+    if jax.process_count() == 1:
+        return jax.device_put(arr, sh)
+    return jax.make_array_from_process_local_data(sh, arr)
+
+
+def equal_row_target(n_local: int, mesh: Mesh, multiple: int = 1) -> int:
+    """Local row count every process should pad to so the global row axis
+    splits evenly across all mesh devices: max over processes, rounded up
+    to a multiple of (local device count x `multiple`)."""
+    ld = max(local_device_count(mesh), 1) * max(multiple, 1)
+    if jax.process_count() == 1:
+        return max(ld, -(-n_local // ld) * ld)
+    from .collectives import host_allgather_objects
+
+    counts = host_allgather_objects(int(n_local))
+    return max(ld, -(-max(counts) // ld) * ld)
+
+
 def distributed_initialize_if_needed(**kwargs) -> None:
     """Multi-host rendezvous: replaces the reference's CommMaster process
     (reference: worker/TrainWorker.java:139, bin/local_optimizer.sh:38-47).

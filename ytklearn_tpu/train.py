@@ -28,7 +28,6 @@ from .io.fs import FileSystem, LocalFileSystem
 from .io.reader import DataIngest, IngestResult, SparseDataset
 from .models.linear import LinearModel
 from .optimize import LBFGSConfig, inv_hessian_vp, minimize_lbfgs
-from .parallel.mesh import row_sharding
 
 log = logging.getLogger("ytklearn_tpu.train")
 
@@ -116,23 +115,31 @@ class HoagTrainer:
 
     def _device_batch(self, model, ds: SparseDataset) -> Tuple:
         """Build the model's batch and shard rows over the mesh (weights on
-        padding rows are 0 so every weighted reduction ignores them)."""
-        if self.mesh is not None:
-            ds = ds.pad_rows(self.mesh.devices.size)
-        host = model.make_batch(ds)
+        padding rows are 0 so every weighted reduction ignores them).
+
+        Multi-process: `ds` is this process's ingest shard; shards are
+        padded to equal length and assembled into one global row-sharded
+        array per field (each worker's rows become its device shard)."""
+        from .parallel.mesh import equal_row_target, put_row_sharded
+
         if self.mesh is None:
+            host = model.make_batch(ds)
             return tuple(jax.device_put(a) for a in host)
-        sh = row_sharding(self.mesh)
-        return tuple(jax.device_put(a, sh) for a in host)
+        ds = ds.pad_rows(equal_row_target(ds.n, self.mesh))
+        host = model.make_batch(ds)
+        return tuple(put_row_sharded(a, self.mesh) for a in host)
 
     def train(self, ingest: Optional[IngestResult] = None) -> TrainResult:
         p = self.params
         t0 = time.time()
+        ts = self.time_stats = {}  # phase counters (data/gbdt/TimeStats.java
+        # + TrainWorker.java:209-212 LoadDataFlow/PreprocessAndTrain segments)
         if ingest is None:
             ingest = self._ingest()
+        ts["load"] = time.time() - t0
         log.info(
             "load flow done in %.1fs: %d train rows, dim %d",
-            time.time() - t0,
+            ts["load"],
             ingest.train.n_real,
             ingest.train.dim,
         )
@@ -142,6 +149,13 @@ class HoagTrainer:
         test_b = self._device_batch(model, ingest.test) if ingest.test else None
         g_weight = float(np.sum(ingest.train.weight))
         g_weight_test = float(np.sum(ingest.test.weight)) if ingest.test else 0.0
+        if jax.process_count() > 1:
+            # global weight normalizers (reference: CoreData.globalSync
+            # weight allreduce)
+            from .parallel.collectives import host_allgather_objects
+
+            g_weight = float(sum(host_allgather_objects(g_weight)))
+            g_weight_test = float(sum(host_allgather_objects(g_weight_test)))
 
         # continue_train / just_evaluate warm start (LinearModelDataFlow.loadModel)
         w0 = None
@@ -365,12 +379,23 @@ class HoagTrainer:
         evaluate(res.w, sink)
         out.train_metrics = sink.get("train_metrics", {})
         out.test_metrics = sink.get("test_metrics", {})
+        ts["train"] = time.time() - t0 - ts["load"]
+        if res.n_iter > 0 and ts["train"] > 0:
+            ts["iters_per_sec"] = res.n_iter / ts["train"]
         log.info(
             "training done: %s after %d iters, avg loss %.6f, metrics %s",
             res.status,
             res.n_iter,
             out.avg_loss,
             out.train_metrics,
+        )
+        log.info(
+            "[time stats] load=%.1fs train=%.1fs%s",
+            ts["load"], ts["train"],
+            (
+                f" rate={ts['iters_per_sec']:.2f} iters/s"
+                if "iters_per_sec" in ts else ""
+            ),
         )
         return out
 
@@ -382,4 +407,6 @@ class HoagTrainer:
             precision = np.asarray(
                 jit_precision(w, *train_b, l2_vec=l2_vec, g_weight=g_weight)
             )
+        if jax.process_index() != 0:
+            return  # rank0-only dump (reference: HoagOptimizer.java:647-660)
         model.dump_model(self.fs, np.asarray(w), precision, ingest.feature_map)
