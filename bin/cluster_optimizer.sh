@@ -1,0 +1,55 @@
+#!/usr/bin/env bash
+# Multi-process / multi-host train launcher (reference surface:
+# bin/cluster_optimizer.sh:55-79 — CommMaster + per-host slave fan-out).
+# Here the rendezvous is the jax.distributed coordinator: rank 0's host
+# serves it, every rank connects with --coordinator/--num-processes/
+# --process-id. With YTK_SLAVE_HOSTS unset, all ranks fork locally (the
+# multiple-workers-on-one-host pattern the reference used for testing);
+# set YTK_SLAVE_HOSTS="host1 host2 ..." to launch ranks 1..N-1 over ssh.
+# Extra arguments pass through to `ytklearn_tpu.cli train` (e.g. --set).
+set -euo pipefail
+
+REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+export PYTHONPATH="${REPO_ROOT}${PYTHONPATH:+:${PYTHONPATH}}"
+
+model_name="${1:?usage: cluster_optimizer.sh <model> <config> <num_processes> [train args...]}"
+properties_path="${2:?usage: cluster_optimizer.sh <model> <config> <num_processes> [train args...]}"
+num_procs="${3:?usage: cluster_optimizer.sh <model> <config> <num_processes> [train args...]}"
+shift 3
+
+read -r -a slave_hosts <<<"${YTK_SLAVE_HOSTS:-}"
+coordinator_host="${YTK_COORDINATOR_HOST:-127.0.0.1}"
+coordinator_port="${YTK_COORDINATOR_PORT:-29401}"
+coordinator="${coordinator_host}:${coordinator_port}"
+
+log_dir="$(mktemp -d /tmp/ytk_cluster.XXXXXX)"
+echo "rank logs: ${log_dir}" >&2
+
+pids=()
+cleanup() {
+  for pid in "${pids[@]:-}"; do
+    kill "${pid}" 2>/dev/null || true
+  done
+}
+trap cleanup EXIT
+
+for ((rank = num_procs - 1; rank >= 0; rank--)); do
+  cmd=(python -m ytklearn_tpu.cli train "${model_name}" "${properties_path}"
+       --coordinator "${coordinator}" --num-processes "${num_procs}"
+       --process-id "${rank}" "$@")
+  if ((rank == 0)); then
+    "${cmd[@]}"  # rank 0 foreground: serves the coordinator, prints results
+  elif ((${#slave_hosts[@]} > 0)); then
+    host="${slave_hosts[$(((rank - 1) % ${#slave_hosts[@]}))]}"
+    ssh "${host}" "cd ${REPO_ROOT} && PYTHONPATH=${REPO_ROOT} ${cmd[*]}" \
+      >"${log_dir}/rank${rank}.log" 2>&1 &
+    pids+=($!)
+  else
+    "${cmd[@]}" >"${log_dir}/rank${rank}.log" 2>&1 &
+    pids+=($!)
+  fi
+done
+if ((${#pids[@]} > 0)); then
+  wait "${pids[@]}"
+fi
+pids=()  # clean exit: nothing left for the trap to kill

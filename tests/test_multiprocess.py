@@ -85,6 +85,31 @@ def test_two_process_linear_matches_single(tmp_path):
     assert dist["avg_loss"] < 0.45
 
 
+def test_cluster_launcher_two_ranks(tmp_path):
+    """bin/cluster_optimizer.sh forks N CLI ranks against one coordinator
+    (reference: bin/cluster_optimizer.sh slave fan-out)."""
+    _write_data(tmp_path)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["YTK_PLATFORM"] = "cpu"
+    env["YTK_COORDINATOR_PORT"] = str(_free_port())
+    env.pop("XLA_FLAGS", None)
+    out = subprocess.run(
+        ["bash", os.path.join(REPO, "bin", "cluster_optimizer.sh"), "linear",
+         f"{os.environ.get('YTK_REF', '/root/reference')}/demo/linear/binary_classification/linear.conf",
+         "2",
+         "--set", f"data.train.data_path={tmp_path / 'train.ytk'}",
+         "--set", "data.test.data_path=",
+         "--set", f"model.data_path={tmp_path / 'model'}",
+         "--set", "optimization.line_search.lbfgs.convergence.max_iter=6"],
+        capture_output=True, text=True, env=env, timeout=420,
+    )
+    assert out.returncode == 0, out.stderr[-3000:]
+    res = json.loads(out.stdout.strip().splitlines()[-1])
+    assert res["n_iter"] == 6 and res["avg_loss"] < 0.45
+    assert (tmp_path / "model").exists()
+
+
 def test_two_process_gbdt_matches_single(tmp_path):
     _write_data(tmp_path)
     dist = _run("gbdt", tmp_path, 2)

@@ -97,11 +97,38 @@ def train_main(argv: Optional[List[str]] = None) -> int:
                     help="on failure, retry with model.continue_train=true to "
                     "resume from the last checkpoint dump (reference: the "
                     "bin/hadoop_optimizer.sh:53-80 restart loop)")
+    ap.add_argument("--coordinator", default="",
+                    help="host:port of the jax.distributed coordinator — the "
+                    "CommMaster equivalent; use with --num-processes/"
+                    "--process-id for multi-host training")
+    ap.add_argument("--num-processes", type=int, default=0)
+    ap.add_argument("--process-id", type=int, default=-1)
     ap.add_argument("--set", action="append", dest="sets", metavar="KEY=VALUE",
                     help="config override, repeatable")
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args(argv)
     _setup_logging(args.verbose)
+
+    import os as _os
+
+    if _os.environ.get("YTK_PLATFORM"):
+        # explicit platform pin that works even when a sitecustomize
+        # pre-imported jax and already captured JAX_PLATFORMS
+        import jax
+
+        jax.config.update("jax_platforms", _os.environ["YTK_PLATFORM"])
+    if args.coordinator:
+        # multi-host rendezvous BEFORE any backend touch (the CommMaster
+        # equivalent; reference: bin/cluster_optimizer.sh slave fan-out).
+        # Unset world params stay None so jax auto-detects pod topology.
+        from .parallel.mesh import distributed_initialize_if_needed
+
+        kw = {"coordinator_address": args.coordinator}
+        if args.num_processes > 0:
+            kw["num_processes"] = args.num_processes
+        if args.process_id >= 0:
+            kw["process_id"] = args.process_id
+        distributed_initialize_if_needed(**kw)
 
     from .config import hocon
 
@@ -112,6 +139,17 @@ def train_main(argv: Optional[List[str]] = None) -> int:
 
     log = logging.getLogger("ytklearn_tpu.cli")
     restarts = max(args.max_restarts, 0)
+    if restarts and args.coordinator:
+        # a single rank re-entering training would desynchronize the
+        # group's collectives; multi-process recovery = restart the whole
+        # launcher with continue_train (the reference's model too:
+        # bin/hadoop_optimizer.sh restarts the entire job)
+        log.warning(
+            "--max-restarts is per-process and unsafe in multi-process "
+            "mode; disabled — restart the launcher to resume from the "
+            "last checkpoint"
+        )
+        restarts = 0
     for attempt in range(restarts + 1):
         try:
             return _train_once(name, cfg, mesh, hook)

@@ -196,6 +196,28 @@ class GBDTTrainer:
         p = self.params
         return (p.l1, p.l2, p.min_child_hessian_sum, p.max_abs_leaf_val)
 
+    def _load_resume_model(self, model: GBDTModel, K: int):
+        """continue_train reload (reference: GBDTOptimizer.java:408 resume at
+        trees/K). Rank0 reads, every rank resumes from rank0's text — dumps
+        are rank0-only, so on non-shared storage other ranks would
+        otherwise silently start from scratch and corrupt the run."""
+        p = self.params
+        if not p.model.continue_train:
+            return model, 0
+        text = None
+        if jax.process_index() == 0 and self.fs.exists(p.model.data_path):
+            with self.fs.open(p.model.data_path) as f:
+                text = f.read()
+        if jax.process_count() > 1:
+            from ..parallel.collectives import host_allgather_objects
+
+            text = host_allgather_objects(text)[0]
+        if text is None:
+            return model, 0
+        model = GBDTModel.loads(text)
+        log.info("continue_train: loaded %d trees", len(model.trees))
+        return model, len(model.trees) // K
+
     def _shard_target(self, bins_np) -> Optional[int]:
         """Multi-process: pad this process's rows to the cross-process
         equalized target (bm-block divisible per device); single-process:
@@ -334,13 +356,7 @@ class GBDTTrainer:
             num_tree_in_group=K,
             obj_name=self.loss.name,
         )
-        start_round = 0
-        model_path = p.model.data_path
-        if p.model.continue_train and self.fs.exists(model_path):
-            with self.fs.open(model_path) as f:
-                model = GBDTModel.loads(f.read())
-            start_round = len(model.trees) // K
-            log.info("continue_train: loaded %d trees", len(model.trees))
+        model, start_round = self._load_resume_model(model, K)
 
         if K > 1:
             scores = jnp.full((n_score, K), base_np, jnp.float32)
@@ -919,13 +935,7 @@ class GBDTTrainer:
         )
 
         # continue_train: reload + replay scores
-        start_round = 0
-        model_path = p.model.data_path
-        if p.model.continue_train and self.fs.exists(model_path):
-            with self.fs.open(model_path) as f:
-                model = GBDTModel.loads(f.read())
-            start_round = len(model.trees) // K
-            log.info("continue_train: loaded %d trees", len(model.trees))
+        model, start_round = self._load_resume_model(model, K)
 
         if K > 1:
             scores = jnp.full((n, K), base_np, jnp.float32)

@@ -198,7 +198,13 @@ class SparseDataset:
         replacement for the reference's ragged per-worker row counts."""
         n = self.idx.shape[0]
         target = (n + multiple - 1) // multiple * multiple
-        if target == n:
+        return self.pad_rows_to(target)
+
+    def pad_rows_to(self, target: int) -> "SparseDataset":
+        """Pad to an exact row count (multi-process shard equalization —
+        an empty shard still pads up to the group-agreed target)."""
+        n = self.idx.shape[0]
+        if target <= n:
             return self
         pad = target - n
         return dataclasses.replace(

@@ -125,7 +125,7 @@ class HoagTrainer:
         if self.mesh is None:
             host = model.make_batch(ds)
             return tuple(jax.device_put(a) for a in host)
-        ds = ds.pad_rows(equal_row_target(ds.n, self.mesh))
+        ds = ds.pad_rows_to(equal_row_target(ds.n, self.mesh))
         host = model.make_batch(ds)
         return tuple(put_row_sharded(a, self.mesh) for a in host)
 
@@ -160,7 +160,14 @@ class HoagTrainer:
         # continue_train / just_evaluate warm start (LinearModelDataFlow.loadModel)
         w0 = None
         if p.model.continue_train or p.loss.just_evaluate:
-            w0 = model.load_model(self.fs, ingest.feature_map)
+            # rank0 reads, every rank warm-starts from rank0's weights
+            # (dumps are rank0-only; non-shared storage would diverge)
+            if jax.process_index() == 0:
+                w0 = model.load_model(self.fs, ingest.feature_map)
+            if jax.process_count() > 1:
+                from .parallel.collectives import host_allgather_objects
+
+                w0 = host_allgather_objects(w0)[0]
             if w0 is not None:
                 log.info("continue_train: loaded existing model")
         if w0 is None:
