@@ -20,6 +20,12 @@ shift 3
 read -r -a slave_hosts <<<"${YTK_SLAVE_HOSTS:-}"
 coordinator_host="${YTK_COORDINATOR_HOST:-127.0.0.1}"
 coordinator_port="${YTK_COORDINATOR_PORT:-29401}"
+if ((${#slave_hosts[@]} > 0)) && [[ "${coordinator_host}" == "127.0.0.1" ]]; then
+  echo "error: YTK_SLAVE_HOSTS is set but YTK_COORDINATOR_HOST is the" >&2
+  echo "loopback default — remote ranks would dial themselves. Set" >&2
+  echo "YTK_COORDINATOR_HOST to a host reachable from every slave." >&2
+  exit 2
+fi
 coordinator="${coordinator_host}:${coordinator_port}"
 
 log_dir="$(mktemp -d /tmp/ytk_cluster.XXXXXX)"
@@ -41,7 +47,8 @@ for ((rank = num_procs - 1; rank >= 0; rank--)); do
     "${cmd[@]}"  # rank 0 foreground: serves the coordinator, prints results
   elif ((${#slave_hosts[@]} > 0)); then
     host="${slave_hosts[$(((rank - 1) % ${#slave_hosts[@]}))]}"
-    ssh "${host}" "cd ${REPO_ROOT} && PYTHONPATH=${REPO_ROOT} ${cmd[*]}" \
+    remote_cmd="$(printf '%q ' "${cmd[@]}")"
+    ssh "${host}" "cd $(printf '%q' "${REPO_ROOT}") && PYTHONPATH=$(printf '%q' "${REPO_ROOT}") ${remote_cmd}" \
       >"${log_dir}/rank${rank}.log" 2>&1 &
     pids+=($!)
   else
@@ -49,7 +56,13 @@ for ((rank = num_procs - 1; rank >= 0; rank--)); do
     pids+=($!)
   fi
 done
-if ((${#pids[@]} > 0)); then
-  wait "${pids[@]}"
-fi
+# wait each pid individually: `wait p1 p2` only reports the LAST status,
+# which would swallow a crashed rank
+rc=0
+for pid in "${pids[@]}"; do
+  if ! wait "${pid}"; then
+    rc=1
+  fi
+done
 pids=()  # clean exit: nothing left for the trap to kill
+exit "${rc}"

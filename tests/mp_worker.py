@@ -74,6 +74,26 @@ def gbdt() -> dict:
     }
 
 
-out = {"linear": linear, "gbdt": gbdt}[mode]()
+def gbst() -> dict:
+    from ytklearn_tpu.boost import GBSTTrainer
+    from ytklearn_tpu.config.params import CommonParams
+
+    p = CommonParams()
+    p.data.train_paths = [os.path.join(workdir, "train.ytk")]
+    p.data.test_paths = []
+    p.data.assigned = False
+    p.data.unassigned_mode = "lines_avg"
+    p.model.data_path = os.path.join(workdir, f"gbst_mp{nprocs}")
+    p.loss.loss_function = "sigmoid"
+    p.loss.evaluate_metric = []
+    p.line_search.lbfgs_max_iter = 6
+    p.k = 2
+    p.tree_num = 2
+    mesh = make_mesh(len(jax.devices()))
+    res = GBSTTrainer(p, "gbmlr", mesh=mesh).train()
+    return {"train_loss": float(res.train_loss), "trees": int(res.n_trees)}
+
+
+out = {"linear": linear, "gbdt": gbdt, "gbst": gbst}[mode]()
 if rank == 0:
     print("RESULT " + json.dumps(out), flush=True)

@@ -110,6 +110,14 @@ def test_cluster_launcher_two_ranks(tmp_path):
     assert (tmp_path / "model").exists()
 
 
+def test_two_process_gbst_matches_single(tmp_path):
+    _write_data(tmp_path)
+    dist = _run("gbst", tmp_path, 2)
+    single = _run("gbst", tmp_path, 1)
+    assert dist["trees"] == single["trees"] == 2
+    assert dist["train_loss"] == pytest.approx(single["train_loss"], rel=1e-3)
+
+
 def test_two_process_gbdt_matches_single(tmp_path):
     _write_data(tmp_path)
     dist = _run("gbdt", tmp_path, 2)

@@ -27,7 +27,6 @@ from .io.reader import DataIngest, IngestResult
 from .losses import create_loss
 from .models.gbst import GBSTModel
 from .optimize import LBFGSConfig, minimize_lbfgs
-from .parallel.mesh import row_sharding
 
 log = logging.getLogger("ytklearn_tpu.boost")
 
@@ -58,9 +57,12 @@ class GBSTTrainer:
         self.fs = fs or LocalFileSystem()
 
     def _put(self, arr):
+        """Row-shard dim 0; multi-process: `arr` is this process's shard."""
         if self.mesh is None:
             return jax.device_put(arr)
-        return jax.device_put(arr, row_sharding(self.mesh))
+        from .parallel.mesh import put_row_sharded
+
+        return put_row_sharded(arr, self.mesh)
 
     def _put_rep(self, arr):
         return jax.device_put(arr)
@@ -73,8 +75,13 @@ class GBSTTrainer:
         ds_train = ingest.train
         ds_test = ingest.test
         if self.mesh is not None:
-            ds_train = ds_train.pad_rows(self.mesh.devices.size)
-            ds_test = ds_test.pad_rows(self.mesh.devices.size) if ds_test else None
+            from .parallel.mesh import equal_row_target
+
+            ds_train = ds_train.pad_rows_to(equal_row_target(ds_train.n, self.mesh))
+            ds_test = (
+                ds_test.pad_rows_to(equal_row_target(ds_test.n, self.mesh))
+                if ds_test else None
+            )
 
         model = GBSTModel(p, ingest.train.dim, self.variant)
         loss_fn = model.loss
@@ -83,6 +90,11 @@ class GBSTTrainer:
         tree_num = p.tree_num
         g_weight = float(np.sum(ds_train.weight))
         g_weight_test = float(np.sum(ds_test.weight)) if ds_test else 0.0
+        if jax.process_count() > 1:
+            from .parallel.collectives import host_allgather_objects
+
+            g_weight = float(sum(host_allgather_objects(g_weight)))
+            g_weight_test = float(sum(host_allgather_objects(g_weight_test)))
 
         idx = self._put(ds_train.idx)
         val = self._put(ds_train.val)
@@ -105,14 +117,23 @@ class GBSTTrainer:
         l1_vec, l2_vec = model.reg_vectors(p.loss.l1[0], p.loss.l2[0])
 
         # continue_train: replay finished trees into z
-        # (reference: GBMLRDataFlow.loadModel + per-tree accumulate)
+        # (reference: GBMLRDataFlow.loadModel + per-tree accumulate).
+        # Rank0 reads the checkpoints, peers take its broadcast — dumps are
+        # rank0-only so non-shared storage must not diverge on resume.
+        from .parallel.collectives import load_on_rank0
+
         finished = 0
-        info = model.load_tree_info(self.fs)
+        info = load_on_rank0(lambda: model.load_tree_info(self.fs))
         if (p.model.continue_train or p.loss.just_evaluate) and info is not None:
             finished = int(info["finished_tree_num"])
             full_mask = self._put_rep(np.ones((model.n_features,), np.float32))
-            for t in range(finished):
-                wt = model.load_tree(self.fs, ingest.feature_map, t)
+            trees_w = load_on_rank0(
+                lambda: [
+                    model.load_tree(self.fs, ingest.feature_map, t)
+                    for t in range(finished)
+                ]
+            )
+            for t, wt in enumerate(trees_w):
                 if wt is None:
                     raise FileNotFoundError(f"tree-{t:05d} missing for continue_train")
                 wt = self._put_rep(wt)
@@ -121,15 +142,19 @@ class GBSTTrainer:
                     z_t = z_t + lr * jit_tree_out(wt, idx_t, val_t, full_mask)
             log.info("continue_train: replayed %d finished trees", finished)
 
-        rng = np.random.RandomState(p.random.seed)
+        # two rng streams: the feature stream draws fixed-size vectors so it
+        # stays bitwise-identical across ranks; the instance stream draws
+        # local-shard-sized vectors and is rank-local by construction
+        rng_inst = np.random.RandomState(p.random.seed)
+        rng_feat = np.random.RandomState(p.random.seed + 104729)
         per_tree_loss: List[float] = []
         compensate = 1.0 / p.instance_sample_rate
 
         for tree in range(finished, tree_num):
             # per-tree Bernoulli masks (reference: randomNextSample)
-            inst = (rng.rand(ds_train.n) <= p.instance_sample_rate).astype(np.float32)
+            inst = (rng_inst.rand(ds_train.n) <= p.instance_sample_rate).astype(np.float32)
             inst[ds_train.n_real :] = 0.0
-            gmask_np = (rng.rand(model.n_features) <= p.feature_sample_rate).astype(
+            gmask_np = (rng_feat.rand(model.n_features) <= p.feature_sample_rate).astype(
                 np.float32
             )
             if p.model.need_bias:
@@ -159,11 +184,12 @@ class GBSTTrainer:
             if ds_test is not None:
                 z_t = z_t + lr * jit_tree_out(w_tree, idx_t, val_t, gmask)
 
-            # dump tree + info (reference: dumpModel + dumpModelInfo)
-            model.dump_tree(
-                self.fs, np.asarray(w_tree), gmask_np, ingest.feature_map, tree
-            )
-            model.dump_tree_info(self.fs, tree + 1, base_score)
+            # dump tree + info, rank0-only (reference: dumpModel + dumpModelInfo)
+            if jax.process_index() == 0:
+                model.dump_tree(
+                    self.fs, np.asarray(w_tree), gmask_np, ingest.feature_map, tree
+                )
+                model.dump_tree_info(self.fs, tree + 1, base_score)
 
             ens = self._ensemble_scores(z, tree + 1)
             tl = float(jit_ens_loss(ens, y, weight)) / g_weight

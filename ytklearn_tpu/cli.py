@@ -117,18 +117,21 @@ def train_main(argv: Optional[List[str]] = None) -> int:
         import jax
 
         jax.config.update("jax_platforms", _os.environ["YTK_PLATFORM"])
-    if args.coordinator:
-        # multi-host rendezvous BEFORE any backend touch (the CommMaster
-        # equivalent; reference: bin/cluster_optimizer.sh slave fan-out).
-        # Unset world params stay None so jax auto-detects pod topology.
-        from .parallel.mesh import distributed_initialize_if_needed
+    # multi-host rendezvous BEFORE any backend touch (the CommMaster
+    # equivalent; reference: bin/cluster_optimizer.sh slave fan-out).
+    # Without --coordinator this is a no-op unless YTKLEARN_TPU_DISTRIBUTED=1
+    # asks for pod auto-detection; unset world params stay None so jax
+    # auto-detects topology.
+    from .parallel.mesh import distributed_initialize_if_needed
 
-        kw = {"coordinator_address": args.coordinator}
+    kw = {}
+    if args.coordinator:
+        kw["coordinator_address"] = args.coordinator
         if args.num_processes > 0:
             kw["num_processes"] = args.num_processes
         if args.process_id >= 0:
             kw["process_id"] = args.process_id
-        distributed_initialize_if_needed(**kw)
+    distributed_initialize_if_needed(**kw)
 
     from .config import hocon
 
@@ -139,7 +142,9 @@ def train_main(argv: Optional[List[str]] = None) -> int:
 
     log = logging.getLogger("ytklearn_tpu.cli")
     restarts = max(args.max_restarts, 0)
-    if restarts and args.coordinator:
+    import jax as _jax
+
+    if restarts and _jax.process_count() > 1:
         # a single rank re-entering training would desynchronize the
         # group's collectives; multi-process recovery = restart the whole
         # launcher with continue_train (the reference's model too:

@@ -204,14 +204,15 @@ class GBDTTrainer:
         p = self.params
         if not p.model.continue_train:
             return model, 0
-        text = None
-        if jax.process_index() == 0 and self.fs.exists(p.model.data_path):
-            with self.fs.open(p.model.data_path) as f:
-                text = f.read()
-        if jax.process_count() > 1:
-            from ..parallel.collectives import host_allgather_objects
+        from ..parallel.collectives import load_on_rank0
 
-            text = host_allgather_objects(text)[0]
+        def read():
+            if not self.fs.exists(p.model.data_path):
+                return None
+            with self.fs.open(p.model.data_path) as f:
+                return f.read()
+
+        text = load_on_rank0(read)
         if text is None:
             return model, 0
         model = GBDTModel.loads(text)

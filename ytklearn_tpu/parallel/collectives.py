@@ -105,6 +105,17 @@ def axis_size(axis_name: str = DATA_AXIS):
 # ---------------------------------------------------------------------------
 
 
+def load_on_rank0(fn):
+    """Run `fn()` on process 0 and broadcast its return value to every
+    rank (rank0-only checkpoint dumps must not diverge on non-shared
+    storage). Single-process: just `fn()`. All ranks MUST call this at the
+    same point — it is a collective."""
+    obj = fn() if jax.process_index() == 0 else None
+    if jax.process_count() == 1:
+        return obj
+    return host_allgather_objects(obj)[0]
+
+
 def host_allgather_objects(obj):
     """Gather a small python object from every process; returns a list with
     one entry per process, in rank order (multi-host only — single-process

@@ -157,17 +157,16 @@ class HoagTrainer:
             g_weight = float(sum(host_allgather_objects(g_weight)))
             g_weight_test = float(sum(host_allgather_objects(g_weight_test)))
 
-        # continue_train / just_evaluate warm start (LinearModelDataFlow.loadModel)
+        # continue_train / just_evaluate warm start (LinearModelDataFlow
+        # .loadModel); rank0 reads, every rank warm-starts from its
+        # broadcast (dumps are rank0-only; non-shared storage would diverge)
         w0 = None
         if p.model.continue_train or p.loss.just_evaluate:
-            # rank0 reads, every rank warm-starts from rank0's weights
-            # (dumps are rank0-only; non-shared storage would diverge)
-            if jax.process_index() == 0:
-                w0 = model.load_model(self.fs, ingest.feature_map)
-            if jax.process_count() > 1:
-                from .parallel.collectives import host_allgather_objects
+            from .parallel.collectives import load_on_rank0
 
-                w0 = host_allgather_objects(w0)[0]
+            w0 = load_on_rank0(
+                lambda: model.load_model(self.fs, ingest.feature_map)
+            )
             if w0 is not None:
                 log.info("continue_train: loaded existing model")
         if w0 is None:
