@@ -61,10 +61,23 @@ def auc_from_histogram(hist) -> jnp.ndarray:
 
 
 def auc(pred, y, weight=None, slots: int = DEFAULT_AUC_SLOTS):
-    """(weighted, unweighted) AUC — single-shard convenience."""
+    """(weighted, unweighted) AUC — single-shard convenience.
+
+    Multiclass (pred (n, K)) is micro-averaged: each (sample, class)
+    probability scores the binary event y[:, k] == 1, with the sample
+    weight repeated per class."""
     pred = jnp.asarray(pred)
     y = jnp.asarray(y)
-    w = jnp.ones_like(pred) if weight is None else jnp.asarray(weight)
+    w = (
+        jnp.ones(pred.shape[:1], pred.dtype)
+        if weight is None
+        else jnp.asarray(weight)
+    )
+    if pred.ndim == 2:
+        K = pred.shape[1]
+        pred = pred.reshape(-1)
+        y = y.reshape(-1)
+        w = jnp.repeat(w, K)
     weighted = auc_from_histogram(auc_histogram(pred, y, w, slots))
     mask = (w != 0).astype(pred.dtype)
     unweighted = auc_from_histogram(auc_histogram(pred, y, mask, slots))

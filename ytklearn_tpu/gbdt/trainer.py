@@ -519,6 +519,11 @@ class GBDTTrainer:
 
         carry = (scores, scores_t, bufs, loss_buf, tloss_buf)
         sync_every = max(1, (p.round_num - start_round) // 20)
+        watch_eval = (
+            EvalSet(p.eval_metric, K=max(K, 2))
+            if p.eval_metric and (p.watch_train or p.watch_test)
+            else None
+        )
         self.sync_log: List[Tuple[int, float]] = []  # (round, wall s) at syncs
         profile_dir = os.environ.get("YTK_PROFILE_DIR")
         if profile_dir:
@@ -535,6 +540,24 @@ class GBDTTrainer:
                 msg = f"[round={rnd}] {elapsed:.1f}s train loss={tl:.6f}"
                 if has_test:
                     msg += f" test loss={float(carry[4][rnd]):.6f}"
+                # watch-flag metrics at sync points (reference: EvalSet per
+                # round when watch_train/watch_test; here per sync so the
+                # enqueue pipeline stays deep between syncs)
+                if watch_eval is not None:
+                    if p.watch_train:
+                        m = watch_eval.evaluate(
+                            loss_fn.predict(carry[0]), y, weight
+                        )
+                        msg += " train " + " ".join(
+                            f"{k}={v:.6f}" for k, v in m.items()
+                        )
+                    if p.watch_test and has_test:
+                        m = watch_eval.evaluate(
+                            loss_fn.predict(carry[1]), y_t, w_t
+                        )
+                        msg += " test " + " ".join(
+                            f"{k}={v:.6f}" for k, v in m.items()
+                        )
                 log.info(msg)
             if p.model.dump_freq > 0 and (rnd + 1) % p.model.dump_freq == 0:
                 self._append_trees_from_bufs(
