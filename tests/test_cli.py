@@ -159,3 +159,35 @@ def test_cli_transform_hook(tmp_path, capsys):
     assert rc == 0
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert out["train_metrics"]["auc"] > 0.9
+
+
+def test_cli_train_multiclass_demo(tmp_path, capsys):
+    rc = train_main([
+        "multiclass_linear",
+        f"{REF}/demo/multiclass_linear/multiclass_linear.conf",
+        "--set", f"data.train.data_path={REF}/demo/data/ytklearn/dermatology.train.ytklearn",
+        "--set", f"data.test.data_path={REF}/demo/data/ytklearn/dermatology.test.ytklearn",
+        "--set", f"model.data_path={tmp_path / 'mc.model'}",
+        "--set", "optimization.line_search.lbfgs.convergence.max_iter=10",
+    ])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["test_loss"] < 1.0  # well under chance (ln 6 = 1.79) on 6 classes
+    assert (tmp_path / "mc.model").exists()
+
+
+def test_cli_train_gbmlr_demo(tmp_path, capsys):
+    rc = train_main([
+        "gbmlr",
+        f"{REF}/demo/gbmlr/binary_classification/gbmlr.conf",
+        "--set", f"data.train.data_path={REF}/demo/data/ytklearn/agaricus.train.ytklearn",
+        "--set", "data.test.data_path=",
+        "--set", f"model.data_path={tmp_path / 'gbmlr.model'}",
+        "--set", "optimization.line_search.lbfgs.convergence.max_iter=6",
+        "--set", "k=4",
+        "--set", "tree_num=2",
+    ])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["trees"] == 2
+    assert out["train_loss"] < 0.5

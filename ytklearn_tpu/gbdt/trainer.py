@@ -543,7 +543,9 @@ class GBDTTrainer:
                 # watch-flag metrics at sync points (reference: EvalSet per
                 # round when watch_train/watch_test; here per sync so the
                 # enqueue pipeline stays deep between syncs)
-                if watch_eval is not None:
+                # the final round skips the watch log: _finalize_device
+                # evaluates the same final scores anyway
+                if watch_eval is not None and rnd != p.round_num - 1:
                     if p.watch_train:
                         m = watch_eval.evaluate(
                             loss_fn.predict(carry[0]), y, weight
