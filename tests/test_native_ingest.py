@@ -155,3 +155,39 @@ def test_parse_block_threads_deterministic():
     np.testing.assert_array_equal(one.feat_ids, many.feat_ids)
     np.testing.assert_array_equal(one.feat_vals, many.feat_vals)
     np.testing.assert_array_equal(one.labels, many.labels)
+
+
+def test_parse_paths_matches_concatenated_parse(tmp_path):
+    """Per-file parse_paths == one parse_block over the concatenation:
+    shard phase carries across file boundaries (incl. error/blank lines),
+    names keep first-seen order across files, ptrs offset correctly."""
+    from ytklearn_tpu.io.fs import LocalFileSystem
+
+    files = {
+        # no trailing newline on purpose (normalization must match);
+        # overlapping + new names across files; an error line and a blank
+        "a.txt": "1###0###x:1,y:2\n1###1###bad-line\n\n1###0###y:3,z:4",
+        "b.txt": "1###1###z:5,w:6\n1###0###x:7\n1###1###q:8,y:9\n",
+        "c.txt": "1###0###w:10\n1###1###x:11,n:12\n",
+    }
+    for name, text in files.items():
+        (tmp_path / name).write_text(text)
+    paths = [str(tmp_path / n) for n in sorted(files)]
+    concat = b"".join(
+        (files[n].encode() + (b"" if files[n].endswith("\n") else b"\n"))
+        for n in sorted(files)
+    )
+    fs = LocalFileSystem()
+    for divisor, remainder in [(1, 0), (2, 0), (2, 1), (3, 2)]:
+        merged = native.parse_paths(
+            fs, paths, divisor=divisor, remainder=remainder
+        )
+        ref = native.parse_block(concat, divisor=divisor, remainder=remainder)
+        assert merged.names == ref.names, (divisor, remainder)
+        assert merged.n_errors == ref.n_errors
+        np.testing.assert_array_equal(merged.weights, ref.weights)
+        np.testing.assert_array_equal(merged.label_ptr, ref.label_ptr)
+        np.testing.assert_array_equal(merged.labels, ref.labels)
+        np.testing.assert_array_equal(merged.row_ptr, ref.row_ptr)
+        np.testing.assert_array_equal(merged.feat_ids, ref.feat_ids)
+        np.testing.assert_array_equal(merged.feat_vals, ref.feat_vals)

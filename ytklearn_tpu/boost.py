@@ -143,9 +143,13 @@ class GBSTTrainer:
             log.info("continue_train: replayed %d finished trees", finished)
 
         # two rng streams: the feature stream draws fixed-size vectors so it
-        # stays bitwise-identical across ranks; the instance stream draws
-        # local-shard-sized vectors and is rank-local by construction
-        rng_inst = np.random.RandomState(p.random.seed)
+        # stays bitwise-identical across ranks; the instance stream folds in
+        # the process index so per-shard sample masks are independent across
+        # ranks instead of perfectly correlated (ADVICE r3; process 0 keeps
+        # the seed unchanged, so single-process runs reproduce as before)
+        rng_inst = np.random.RandomState(
+            (p.random.seed + 7919 * jax.process_index()) % (2**32)
+        )
         rng_feat = np.random.RandomState(p.random.seed + 104729)
         per_tree_loss: List[float] = []
         compensate = 1.0 / p.instance_sample_rate

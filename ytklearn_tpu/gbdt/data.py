@@ -123,10 +123,9 @@ class GBDTIngest:
 
         dp = self.params.data
         paths, divisor, remainder = shard_plan(self.fs, dp, paths)
-        buf = native.read_paths_bytes(self.fs, paths)
         d = dp.delim
-        blk = native.parse_block(
-            buf, d.x_delim, d.y_delim, d.features_delim,
+        blk = native.parse_paths(
+            self.fs, paths, d.x_delim, d.y_delim, d.features_delim,
             d.feature_name_val_delim, divisor=divisor, remainder=remainder,
         )
 

@@ -193,17 +193,90 @@ def parse_block(
         lib.ytk_free(h)
 
 
-def read_paths_bytes(fs, paths: Sequence[str]) -> bytes:
-    """All files (sorted-path order, like fs.read_lines) as one newline-
-    terminated byte buffer — the native parser's input."""
-    chunks: List[bytes] = []
+def parse_paths(
+    fs,
+    paths: Sequence[str],
+    x_delim: str = "###",
+    y_delim: str = ",",
+    features_delim: str = ",",
+    feature_name_val_delim: str = ":",
+    n_threads: int = 0,
+    divisor: int = 1,
+    remainder: int = 0,
+) -> ParsedBlock:
+    """Parse files one at a time and merge the columnar outputs.
+
+    Identical result to one parse_block call over the newline-normalized
+    concatenation of all files in sorted-path order (same rows, errors,
+    first-seen name order), but peak memory holds
+    one file's raw bytes instead of the whole dataset (ADVICE r3: the
+    reference ingest streams per reader thread, DataFlow.java:483-534).
+    The line-modulo shard phase carries across file boundaries: every
+    physical line counts, and each file is newline-normalized, so file k
+    starts at global line sum(lines of files < k)."""
+    blocks: List[ParsedBlock] = []
+    line0 = 0
     for p in sorted(fs.recur_get_paths(paths)):
         with fs.open(p, "rb") as f:
             b = f.read()
-        if b and not b.endswith(b"\n"):
+        if not b:
+            continue
+        if not b.endswith(b"\n"):
             b += b"\n"
-        chunks.append(b)
-    return b"".join(chunks)
+        rem = (remainder - line0) % divisor if divisor > 1 else 0
+        blocks.append(
+            parse_block(
+                b, x_delim, y_delim, features_delim, feature_name_val_delim,
+                n_threads=n_threads, divisor=divisor, remainder=rem,
+            )
+        )
+        line0 += b.count(b"\n")
+        del b
+    return merge_blocks(blocks)
+
+
+def merge_blocks(blocks: Sequence[ParsedBlock]) -> ParsedBlock:
+    """Concatenate ParsedBlocks row-wise, keeping the first-seen feature-name
+    order across blocks (block order = file order = line order)."""
+    if not blocks:
+        return ParsedBlock(
+            weights=np.empty(0, np.float32),
+            label_ptr=np.zeros(1, np.int64),
+            labels=np.empty(0, np.float32),
+            row_ptr=np.zeros(1, np.int64),
+            feat_ids=np.empty(0, np.int32),
+            feat_vals=np.empty(0, np.float32),
+            names=[], n_errors=0,
+        )
+    if len(blocks) == 1:
+        return blocks[0]
+    uniq: dict = {}
+    remapped_ids: List[np.ndarray] = []
+    for blk in blocks:
+        remap = np.asarray(
+            [uniq.setdefault(nm, len(uniq)) for nm in blk.names], np.int32
+        )
+        remapped_ids.append(
+            remap[blk.feat_ids] if len(blk.names) else blk.feat_ids
+        )
+    label_ptr = [np.zeros(1, np.int64)]
+    row_ptr = [np.zeros(1, np.int64)]
+    loff = roff = 0
+    for blk in blocks:
+        label_ptr.append(blk.label_ptr[1:] + loff)
+        row_ptr.append(blk.row_ptr[1:] + roff)
+        loff += int(blk.label_ptr[-1])
+        roff += int(blk.row_ptr[-1])
+    return ParsedBlock(
+        weights=np.concatenate([b.weights for b in blocks]),
+        label_ptr=np.concatenate(label_ptr),
+        labels=np.concatenate([b.labels for b in blocks]),
+        row_ptr=np.concatenate(row_ptr),
+        feat_ids=np.concatenate(remapped_ids),
+        feat_vals=np.concatenate([b.feat_vals for b in blocks]),
+        names=list(uniq),
+        n_errors=sum(b.n_errors for b in blocks),
+    )
 
 
 def expand_labels_columnar(

@@ -415,17 +415,26 @@ class DataIngest:
 
     def load_feature_map(self, dict_paths: Sequence[str]) -> Dict[str, int]:
         """reference: DataFlow.loadDict:244 — bias at 0, then dict file lines
-        in sorted-path order."""
+        in sorted-path order. Rank0 reads, peers take its broadcast — dict
+        sidecars are rank0-only dumps, so on non-shared storage other ranks
+        must not read (or miss) a divergent copy (ADVICE r3)."""
+        from ..parallel.collectives import load_on_rank0
+
+        def read_names():
+            out: List[str] = []
+            for path in sorted(self.fs.recur_get_paths(dict_paths)):
+                with self.fs.open(path) as f:
+                    out.extend(line.strip() for line in f)
+            return out
+
+        names = load_on_rank0(read_names)
         p = self.params
         fmap: Dict[str, int] = {}
         if p.model.need_bias:
             fmap[p.model.bias_feature_name] = 0
-        for path in sorted(self.fs.recur_get_paths(dict_paths)):
-            with self.fs.open(path) as f:
-                for line in f:
-                    name = line.strip()
-                    if name and name not in fmap:
-                        fmap[name] = len(fmap)
+        for name in names:
+            if name and name not in fmap:
+                fmap[name] = len(fmap)
         return fmap
 
     # -- transform ------------------------------------------------------
@@ -557,15 +566,29 @@ class DataIngest:
 
     def _resolve_feature_map(self, counts_fn) -> Dict[str, int]:
         """The dict branch shared by both load paths: load when just_evaluate
-        / need_dict / continue_train finds a sidecar, else build from counts."""
+        / need_dict / continue_train finds a sidecar, else build from counts.
+
+        Rank0 decides which branch applies (the sidecar existence check is a
+        rank0-local fs fact — dumps are rank0-only), then every rank enters
+        the same path: divergent branch picks would leave rank0 inside
+        load_feature_map while peers enter finalize_feature_map's
+        host_allgather collective, hanging the group (ADVICE r3)."""
         p = self.params
         model_dict_path = p.model.data_path + "_dict"
-        if p.loss.just_evaluate and self.fs.exists(model_dict_path):
-            return self.load_feature_map([model_dict_path])
-        if p.model.need_dict and p.model.dict_path:
-            return self.load_feature_map([p.model.dict_path])
-        if p.model.continue_train and self.fs.exists(model_dict_path):
-            return self.load_feature_map([model_dict_path])
+        from ..parallel.collectives import load_on_rank0
+
+        def pick_dict_source():
+            if p.loss.just_evaluate and self.fs.exists(model_dict_path):
+                return [model_dict_path]
+            if p.model.need_dict and p.model.dict_path:
+                return [p.model.dict_path]
+            if p.model.continue_train and self.fs.exists(model_dict_path):
+                return [model_dict_path]
+            return None
+
+        src = load_on_rank0(pick_dict_source)
+        if src is not None:
+            return self.load_feature_map(src)  # rank0-read + broadcast inside
         return self.finalize_feature_map(counts_fn())
 
     def load(self) -> IngestResult:
@@ -638,9 +661,8 @@ class DataIngest:
         p = self.params
         d = p.data.delim
         paths2, divisor, remainder = shard_plan(self.fs, p.data, paths)
-        buf = native.read_paths_bytes(self.fs, paths2)
-        blk = native.parse_block(
-            buf, d.x_delim, d.y_delim, d.features_delim,
+        blk = native.parse_paths(
+            self.fs, paths2, d.x_delim, d.y_delim, d.features_delim,
             d.feature_name_val_delim, divisor=divisor, remainder=remainder,
         )
         n_errors = blk.n_errors
