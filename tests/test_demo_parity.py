@@ -63,9 +63,7 @@ def test_gbdt_demo_convert_train_predict(tmp_path, capsys):
 
 def test_linear_demo_train_predict(tmp_path, capsys):
     train_f = f"{REF}/demo/data/ytklearn/agaricus.train.ytklearn"
-    test_f = str(tmp_path / "agaricus.test.ytklearn")
-    # copy test file so the _predict output lands in tmp
-    open(test_f, "w").write(open(f"{REF}/demo/data/ytklearn/agaricus.test.ytklearn").read())
+    test_f = _copy_test_file(tmp_path)
 
     rc = train_main([
         "linear", LINEAR_CONF,
@@ -87,3 +85,73 @@ def test_linear_demo_train_predict(tmp_path, capsys):
     assert rc == 0
     rec2 = json.loads(out.strip().split("\n")[-1])
     assert rec2["avg_loss"] == pytest.approx(rec["test_loss"], rel=1e-3)
+
+
+def _copy_test_file(tmp_path):
+    """Predict writes <input>_predict next to the input: keep it in tmp."""
+    test_f = str(tmp_path / "agaricus.test.ytklearn")
+    with open(f"{REF}/demo/data/ytklearn/agaricus.test.ytklearn") as src:
+        open(test_f, "w").write(src.read())
+    return test_f
+
+
+@pytest.mark.parametrize("family", ["fm", "ffm"])
+def test_factorization_family_demo_train_predict(tmp_path, capsys, family):
+    """fm/ffm demo configs end-to-end through the CLI (reference:
+    demo/<family>/binary_classification/run.sh), only paths/iters
+    overridden. ffm keeps its reference field.dict (114 of 117 agaricus
+    names have fields; the rest drop, DataFlow.handleLocalIdx)."""
+    conf = f"{REF}/demo/{family}/binary_classification/{family}.conf"
+    test_f = _copy_test_file(tmp_path)
+    sets = [
+        "--set", f"data.train.data_path={REF}/demo/data/ytklearn/agaricus.train.ytklearn",
+        "--set", f"data.test.data_path={test_f}",
+        "--set", f"model.data_path={tmp_path}/{family}.model",
+        "--set", "optimization.line_search.lbfgs.convergence.max_iter=10",
+    ]
+    if family == "ffm":
+        sets += [
+            "--set",
+            f"model.field_dict_path={REF}/demo/ffm/binary_classification/field.dict",
+        ]
+    rc = train_main([family, conf] + sets)
+    out = capsys.readouterr().out
+    assert rc == 0
+    rec = json.loads(out.strip().splitlines()[-1])
+    assert rec["test_metrics"]["auc"] > 0.95
+
+    rc = predict_main([conf, family, test_f] + sets)
+    out = capsys.readouterr().out
+    assert rc == 0
+    rec2 = json.loads(out.strip().splitlines()[-1])
+    assert rec2["avg_loss"] == pytest.approx(rec["test_loss"], rel=1e-3)
+    assert (tmp_path / "agaricus.test.ytklearn_predict").exists()
+
+
+@pytest.mark.parametrize("family", ["gbsdt", "gbhmlr", "gbhsdt"])
+def test_gbst_family_demo_train_predict(tmp_path, capsys, family):
+    """The three GBST demo configs missing CLI acceptance (r3 VERDICT #4):
+    train 2 boosted trees from the unchanged reference config, then batch
+    predict with the offline predictor and check the losses agree."""
+    conf = f"{REF}/demo/{family}/binary_classification/{family}.conf"
+    test_f = _copy_test_file(tmp_path)
+    sets = [
+        "--set", f"data.train.data_path={REF}/demo/data/ytklearn/agaricus.train.ytklearn",
+        "--set", f"data.test.data_path={test_f}",
+        "--set", f"model.data_path={tmp_path}/{family}.model",
+        "--set", "tree_num=2",
+        "--set", "optimization.line_search.lbfgs.convergence.max_iter=6",
+    ]
+    rc = train_main([family, conf] + sets)
+    out = capsys.readouterr().out
+    assert rc == 0
+    rec = json.loads(out.strip().splitlines()[-1])
+    assert rec["trees"] == 2
+    assert rec["train_loss"] < 0.6  # below chance on a separable demo set
+
+    rc = predict_main([conf, family, test_f] + sets)
+    out = capsys.readouterr().out
+    assert rc == 0
+    rec2 = json.loads(out.strip().splitlines()[-1])
+    assert rec2["avg_loss"] == pytest.approx(rec["test_loss"], rel=1e-3)
+    assert (tmp_path / "agaricus.test.ytklearn_predict").exists()

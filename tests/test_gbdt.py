@@ -293,3 +293,39 @@ def test_missing_default_direction(tmp_path, mesh8):
     np.testing.assert_allclose(
         m2.predict_scores(X_nan), model.predict_scores(X_nan), rtol=1e-6
     )
+
+
+def test_feature_importance_reference_format(tmp_path):
+    """Dump format parity with GBDTDataFlow.dumpFeatureImportance:397-415:
+    a header line then name\\tsum_split_count\\tsum_gain rows, counts and
+    gains accumulated per split feature across all trees
+    (Tree.featureImportance:393-408)."""
+    p = make_params(tmp_path, round_num=2)
+    p.model.feature_importance_path = str(tmp_path / "fi.txt")
+    res = GBDTTrainer(p, engine="device").train(train=make_binary(800))
+
+    lines = (tmp_path / "fi.txt").read_text().rstrip("\n").split("\n")
+    assert lines[0] == "feature_name\tsum_split_count\tsum_gain"
+
+    # recompute from the dumped model itself
+    want = {}
+    for t in res.model.trees:
+        for nid in range(t.n_nodes()):
+            if not t.is_leaf(nid):
+                c, g = want.get(t.feat_name[nid], (0, 0.0))
+                want[t.feat_name[nid]] = (c + 1, g + t.gain[nid])
+    got = {}
+    prev_gain = float("inf")
+    for line in lines[1:]:
+        name, cnt, gain = line.split("\t")
+        got[name] = (int(cnt), float(gain))
+        assert float(gain) <= prev_gain  # gain-descending, deterministic
+        prev_gain = float(gain)
+    assert set(got) == set(want)
+    for name in want:
+        assert got[name][0] == want[name][0]
+        assert got[name][1] == pytest.approx(want[name][1], rel=1e-6)
+    assert sum(c for c, _ in got.values()) == sum(
+        len([i for i in range(t.n_nodes()) if not t.is_leaf(i)])
+        for t in res.model.trees
+    )

@@ -1187,10 +1187,13 @@ class GBDTTrainer:
         with self.fs.open(p.model.data_path, "w") as f:
             f.write(model.dumps(with_stats=True))
         if p.model.feature_importance_path:
+            # reference format: header + name\tsum_split_count\tsum_gain
+            # (dataflow/GBDTDataFlow.dumpFeatureImportance:397-415)
             imp = model.feature_importance()
             with self.fs.open(p.model.feature_importance_path, "w") as f:
-                for name, gain in imp.items():
-                    f.write(f"f_{name}:{gain}\n")
+                f.write("feature_name\tsum_split_count\tsum_gain\n")
+                for name, (cnt, gain) in imp.items():
+                    f.write(f"{name}\t{cnt}\t{gain}\n")
 
     def _finalize(
         self, model, scores, y, weight, test_state, eval_set, round_log, bins

@@ -231,11 +231,14 @@ class Tree:
                     t.sample_cnt[nid] = int(float(m.group(9)))
         return t
 
-    def feature_importance(self, acc: Dict[str, float]) -> None:
+    def feature_importance(self, acc: Dict[str, Tuple[int, float]]) -> None:
+        """Accumulate (split_count, gain_sum) per feature name (reference:
+        data/gbdt/Tree.featureImportance feeding GBDTModel:108-114)."""
         for nid in range(self.n_nodes()):
             if not self.is_leaf(nid):
                 name = self.feat_name[nid]
-                acc[name] = acc.get(name, 0.0) + float(self.gain[nid])
+                cnt, gain = acc.get(name, (0, 0.0))
+                acc[name] = (cnt + 1, gain + float(self.gain[nid]))
 
 
 def _jfloat(v: float) -> str:
@@ -285,11 +288,14 @@ class GBDTModel:
         m.trees = [Tree.parse(b) for b in blocks]
         return m
 
-    def feature_importance(self) -> Dict[str, float]:
-        acc: Dict[str, float] = {}
+    def feature_importance(self) -> Dict[str, Tuple[int, float]]:
+        """name -> (sum_split_count, sum_gain), gain-descending (the
+        reference returns an unordered HashMap, GBDTModel.java:108-114;
+        a deterministic order makes the dump reproducible)."""
+        acc: Dict[str, Tuple[int, float]] = {}
         for t in self.trees:
             t.feature_importance(acc)
-        return dict(sorted(acc.items(), key=lambda kv: -kv[1]))
+        return dict(sorted(acc.items(), key=lambda kv: (-kv[1][1], kv[0])))
 
     def predict_scores(self, X: np.ndarray) -> np.ndarray:
         """Raw ensemble scores (host numpy; the trainer keeps a faster
