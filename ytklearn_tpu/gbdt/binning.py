@@ -28,12 +28,18 @@ from __future__ import annotations
 
 import fnmatch
 import math
+import os
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from ..config.params import ApproximateSpec, GBDTParams
+
+# Columns longer than this stream through the weighted GK sketch instead
+# of the full-sort quantile path (sort+cumsum temporaries cost ~4x the
+# column; the sketch is O(b log(n/chunk))). Override: YTK_SKETCH_ROWS.
+SKETCH_ROWS = int(os.environ.get("YTK_SKETCH_ROWS", str(1 << 25)))
 
 
 @dataclass
@@ -50,18 +56,27 @@ class FeatureBins:
     # None when unknown (device-built bins don't track it)
     exact: Optional[np.ndarray] = None
 
-    def split_value(self, fid: int, slot: int, split_type: str = "mean") -> float:
-        """Split cond for 'bins <= slot go left' (reference:
-        FeatureSplitType — interval [slot, slot+1])."""
+    def split_value(
+        self, fid: int, lo: int, hi: Optional[int] = None,
+        split_type: str = "mean",
+    ) -> float:
+        """Split cond for 'bins <= lo go left', where [lo, hi] is the split
+        interval: last nonempty slot strictly before the boundary, and the
+        boundary slot itself (reference: GBDTOptimizer.convertModel:669 +
+        FeatureSplitType mean/median). hi=None means the adjacent interval
+        [lo, lo+1]. The ONE split-value conversion — the trainer's tree
+        conversion and any tooling must route through here (r3 Weak #3)."""
         v = self.values[fid]
         cnt = int(self.counts[fid])
-        hi = min(slot + 1, cnt - 1)
+        if hi is None:
+            hi = lo + 1
+        hi = min(hi, cnt - 1)  # boundary slots are nonempty, so < cnt; clamp
         if split_type == "median":
-            s = slot + hi
+            s = lo + hi
             if s % 2 == 0:
                 return float(v[s // 2])
             return 0.5 * (float(v[(s - 1) // 2]) + float(v[(s + 1) // 2]))
-        return 0.5 * (float(v[slot]) + float(v[hi]))
+        return 0.5 * (float(v[lo]) + float(v[hi]))
 
 
 def _sample_feature(
@@ -100,14 +115,26 @@ def _sample_feature(
             r = r * (hi - lo) + lo
         return np.unique(r.astype(np.float32)), False
     if kind == "sample_by_quantile":
-        vals = np.unique(col)
-        if len(vals) <= spec.max_cnt:
-            return vals, True
         w = (
             np.power(np.maximum(weight, 0.0), spec.alpha)
             if spec.use_sample_weight
             else np.ones_like(col)
         )
+        if len(col) > SKETCH_ROWS:
+            # memory-bounded streaming path (reference: the GK sketch of
+            # WeightApproximateQuantile.java behind SampleByQuantile) —
+            # the full-sort temporaries below cost ~4x the column; the
+            # sketch holds O(b log(n/chunk)) entries instead
+            from .quantile_sketch import WeightedQuantileSketch
+
+            sk = WeightedQuantileSketch(b=max(4 * spec.max_cnt, 256))
+            cs = 1 << 22
+            for i in range(0, len(col), cs):
+                sk.push(col[i : i + cs], w[i : i + cs])
+            return sk.query_values(spec.max_cnt), False
+        vals = np.unique(col)
+        if len(vals) <= spec.max_cnt:
+            return vals, True
         order = np.argsort(col, kind="stable")
         sv, sw = col[order], w[order]
         cw = np.cumsum(sw)
@@ -209,6 +236,7 @@ def merge_bins_multihost(
     local_mass: np.ndarray,
     max_cnt_arr: np.ndarray,
     discrete: np.ndarray,
+    local_summaries: Optional[Dict[int, "object"]] = None,
 ) -> "FeatureBins":
     """Cross-process merge of per-feature bin candidates.
 
@@ -216,17 +244,24 @@ def merge_bins_multihost(
     allreduceMapSetUnion path of SampleManager.java:128; no_sample keeps
     exact-greedy semantics across hosts). Quantile features stay exact as a
     union while every process kept all distinct values AND the union fits
-    that feature's max_cnt; otherwise the weighted-sketch merge applies."""
+    that feature's max_cnt. Otherwise, when every process supplies a GK
+    summary for the feature (local_summaries), the summaries merge with
+    bounded rank error (the reference's Kryo'd Summary allreduce,
+    SampleManager.java:129-143 + WeightApproximateQuantile.merge:476);
+    the candidate-union approximation remains only as a fallback."""
     from ..parallel.collectives import host_allgather_objects
 
     payload = (
         [local.values[f, : local.counts[f]] for f in range(len(local.counts))],
         local_exact,
         local_mass,
+        local_summaries or {},
     )
     gathered = host_allgather_objects(payload)
     if len(gathered) == 1:
         return local
+    from .quantile_sketch import merge_summaries
+
     F = len(local.counts)
     per_feature: List[np.ndarray] = []
     for f in range(F):
@@ -236,6 +271,12 @@ def merge_bins_multihost(
         union = np.unique(np.concatenate(sets))
         if discrete[f] or (all(exacts) and len(union) <= int(max_cnt_arr[f])):
             per_feature.append(union.astype(np.float32))
+        elif all(f in g[3] for g in gathered):
+            merged = g0 = gathered[0][3][f]
+            for g in gathered[1:]:
+                merged = merge_summaries(merged, g[3][f])
+            del g0
+            per_feature.append(merged.query_values(int(max_cnt_arr[f])))
         else:
             per_feature.append(
                 merge_quantile_candidates(sets, masses, int(max_cnt_arr[f]))
@@ -256,12 +297,15 @@ def build_bins_global(
     local = build_bins(X, weight, params, feature_names, seed)
     if jax.process_count() == 1:
         return local
+    from .quantile_sketch import Summary, WeightedQuantileSketch, prune_summary
+
     F = X.shape[1]
     names = feature_names or [str(i) for i in range(F)]
     exact = np.zeros((F,), bool)
     discrete = np.zeros((F,), bool)
     mass = np.zeros((F,), np.float64)
     max_cnt_arr = np.zeros((F,), np.int64)
+    summaries: Dict[int, Summary] = {}
     for f in range(F):
         spec = _spec_for(f, names[f], params.approximate)
         max_cnt_arr[f] = spec.max_cnt
@@ -275,11 +319,27 @@ def build_bins_global(
                 else np.ones_like(weight)
             )
             mass[f] = float(np.sum(w))
+            if not exact[f]:
+                # local GK summary for the bounded-error cross-process
+                # merge (pruned to 4*max_cnt: rank error <= B/(8*max_cnt),
+                # an eighth of the candidate spacing)
+                b = max(4 * int(spec.max_cnt), 256)
+                col = X[:, f]
+                if len(col) > SKETCH_ROWS:
+                    sk = WeightedQuantileSketch(b=b)
+                    cs = 1 << 22
+                    for i in range(0, len(col), cs):
+                        sk.push(col[i : i + cs], w[i : i + cs])
+                    summaries[f] = prune_summary(sk.summary(), b)
+                else:
+                    summaries[f] = prune_summary(Summary.from_exact(col, w), b)
         else:
             discrete[f] = True  # discrete samplers merge by set union
             exact[f] = True
             mass[f] = float(len(X))
-    return merge_bins_multihost(local, exact, mass, max_cnt_arr, discrete)
+    return merge_bins_multihost(
+        local, exact, mass, max_cnt_arr, discrete, summaries
+    )
 
 
 def quantile_bins_device(

@@ -162,7 +162,7 @@ class GrowSpec:
     max_abs: float
     min_split_loss: float
     min_split_samples: float
-    bm: int = 8192
+    bm: int = 16384  # keep in sync with hist.BM_DEFAULT (trainer padding)
     use_bf16: bool = True
     force_dense: bool = False
     hist_mode: str = "mxu"  # "mxu" (bf16/f32 per use_bf16) | "int8" 

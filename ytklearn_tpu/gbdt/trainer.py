@@ -123,6 +123,28 @@ def node_hist_kernel(bins, in_node, g, h, F: int, B: int):
 
 
 @dataclass
+class _DevInputs:
+    """Device-resident training inputs prepared once per run (the device
+    engine's CoreData equivalent): transposed/padded bin matrices, labels,
+    weights, and the program shapes they were padded for."""
+
+    bins: FeatureBins
+    bins_t: jnp.ndarray  # (F_prog, n_pad) transposed bin matrix
+    y: jnp.ndarray
+    weight: jnp.ndarray
+    real_mask: jnp.ndarray
+    n_score: int  # global (cross-process) padded row count
+    F: int  # real feature count
+    F_prog: int  # feature axis padded to the mesh device count
+    B: int  # bin axis padded to a power of two
+    D: int  # mesh device count
+    aux_bins: tuple  # () or (bins_t of the test set,)
+    y_t: Optional[jnp.ndarray]
+    w_t: Optional[jnp.ndarray]
+    nt_score: int
+
+
+@dataclass
 class GBDTResult:
     model: GBDTModel
     train_loss: float
@@ -297,17 +319,14 @@ class GBDTTrainer:
             hist_mode="int8" if self.hist_precision == "int8" else "mxu",
         )
 
-    def _train_device(
-        self, train: Optional[GBDTData], test: Optional[GBDTData]
-    ) -> GBDTResult:
+    def _prep_device_inputs(self, train: GBDTData, test: Optional[GBDTData]):
+        """Binning + padding + device placement for the device engine.
+
+        Returns a _DevInputs with the transposed/padded bin matrices (and
+        test-set twins), label/weight/real-row arrays, and the padded
+        feature count F_prog the growth program is shaped for."""
         p = self.params
-        t0 = time.time()
-        ts = self.time_stats = {}  # TimeStats equivalent (data/gbdt/TimeStats.java)
-        if train is None:
-            train, test = GBDTIngest(p, self.fs).load()
-        ts["load"] = time.time() - t0
         n_real, F = train.n_real, train.n_features
-        K = self.K
         self._missing_fill = train.missing_fill
 
         log.info("building bins (%d features)...", F)
@@ -353,32 +372,10 @@ class GBDTTrainer:
         # global row count (the score/tree program shapes); n_pad stays the
         # per-process shard length
         n_score = n_pad * jax.process_count()
-        ts["preprocess"] = time.time() - t0 - ts["load"]
-        log.info(
-            "load+preprocess %.1fs: %d rows, %d features, %d bins (pad %d)",
-            time.time() - t0, n_real, F, B_real, B,
-        )
-
-        spec = self._grow_spec(F_prog, B)
-        M = spec.max_nodes
-        grow = make_grow_tree(spec, mesh=self.mesh if D > 1 else None)
-
-        base_np = self._base_score(train, K)
-        model = GBDTModel(
-            base_prediction=float(np.mean(base_np)),
-            num_tree_in_group=K,
-            obj_name=self.loss.name,
-        )
-        model, start_round = self._load_resume_model(model, K)
-
-        if K > 1:
-            scores = jnp.full((n_score, K), base_np, jnp.float32)
-        else:
-            scores = jnp.full((n_score,), float(base_np), jnp.float32)
 
         aux_bins = ()
-        scores_t = None
         y_t = w_t = None
+        nt_score = 0
         if test is not None:
             if use_dev_bin:
                 nt = test.X.shape[0]
@@ -401,24 +398,73 @@ class GBDTTrainer:
             y_t = self._put(_pad0(test.y, nt_pad))
             w_t = self._put(_pad0(test.weight, nt_pad))
             nt_score = nt_pad * jax.process_count()
-            if K > 1:
-                scores_t = jnp.full((nt_score, K), base_np, jnp.float32)
-            else:
-                scores_t = jnp.full((nt_score,), float(base_np), jnp.float32)
+        log.info(
+            "%d rows, %d features, %d bins (pad %d)", n_real, F, B_real, B
+        )
+        return _DevInputs(
+            bins=bins, bins_t=bins_t, y=y, weight=weight, real_mask=real_mask,
+            n_score=n_score, F=F, F_prog=F_prog, B=B, D=D,
+            aux_bins=aux_bins, y_t=y_t, w_t=w_t, nt_score=nt_score,
+        )
 
-        # continue_train score replay through the host trees
+    def _init_device_scores(self, model: GBDTModel, dd: "_DevInputs", base_np):
+        """Base-score init + continue_train score replay through host trees."""
+        K = self.K
+        if K > 1:
+            scores = jnp.full((dd.n_score, K), base_np, jnp.float32)
+        else:
+            scores = jnp.full((dd.n_score,), float(base_np), jnp.float32)
+        scores_t = None
+        if dd.y_t is not None:
+            if K > 1:
+                scores_t = jnp.full((dd.nt_score, K), base_np, jnp.float32)
+            else:
+                scores_t = jnp.full((dd.nt_score,), float(base_np), jnp.float32)
         if model.trees:
-            bins_dev = jnp.transpose(bins_t)
-            bins_test_dev = jnp.transpose(aux_bins[0]) if aux_bins else None
+            bins_dev = jnp.transpose(dd.bins_t)
+            bins_test_dev = jnp.transpose(dd.aux_bins[0]) if dd.aux_bins else None
             for i, t in enumerate(model.trees):
-                add = self._tree_scores_from_raw(t, bins, bins_dev)
+                add = self._tree_scores_from_raw(t, dd.bins, bins_dev)
                 scores = scores.at[:, i % K].add(add) if K > 1 else scores + add
                 if scores_t is not None:
-                    add_t = self._tree_scores_from_raw(t, bins, bins_test_dev)
+                    add_t = self._tree_scores_from_raw(t, dd.bins, bins_test_dev)
                     scores_t = (
                         scores_t.at[:, i % K].add(add_t) if K > 1 else scores_t + add_t
                     )
             del bins_dev, bins_test_dev
+        return scores, scores_t
+
+    def _train_device(
+        self, train: Optional[GBDTData], test: Optional[GBDTData]
+    ) -> GBDTResult:
+        p = self.params
+        t0 = time.time()
+        ts = self.time_stats = {}  # TimeStats equivalent (data/gbdt/TimeStats.java)
+        if train is None:
+            train, test = GBDTIngest(p, self.fs).load()
+        ts["load"] = time.time() - t0
+        K = self.K
+
+        dd = self._prep_device_inputs(train, test)
+        bins, bins_t = dd.bins, dd.bins_t
+        aux_bins, y_t, w_t = dd.aux_bins, dd.y_t, dd.w_t
+        y, weight, real_mask = dd.y, dd.weight, dd.real_mask
+        ts["preprocess"] = time.time() - t0 - ts["load"]
+        log.info("load+preprocess %.1fs", time.time() - t0)
+
+        spec = self._grow_spec(dd.F_prog, dd.B)
+        M = spec.max_nodes
+        F, F_prog, B = dd.F, dd.F_prog, dd.B
+        grow = make_grow_tree(spec, mesh=self.mesh if dd.D > 1 else None)
+
+        base_np = self._base_score(train, K)
+        model = GBDTModel(
+            base_prediction=float(np.mean(base_np)),
+            num_tree_in_group=K,
+            obj_name=self.loss.name,
+        )
+        model, start_round = self._load_resume_model(model, K)
+        scores, scores_t = self._init_device_scores(model, dd, base_np)
 
         # tree buffers for the whole run, written on device, fetched once
         T = p.round_num * K
@@ -544,44 +590,39 @@ class GBDTTrainer:
         if profile_dir:
             jax.profiler.start_trace(profile_dir)
         t_train0 = time.time()
+        # lagged sync: materializing a loss through this machine's device
+        # tunnel costs ~115 ms D2H, and fetching the CURRENT round's value
+        # stalls the enqueue pipeline for exactly that long every sync. At
+        # each sync point we enqueue a tiny on-device slice of the loss and
+        # materialize it one sync window LATER — by then it completed long
+        # ago, so the float() costs one RTT of host time with zero device
+        # idle (the queue stays ~2 windows deep; watch mode keeps the
+        # synchronous path since its metric evals fetch eagerly anyway)
+        pending: Optional[Tuple[int, jnp.ndarray, Optional[jnp.ndarray]]] = None
         for rnd in range(start_round, p.round_num):
             carry = jit_round(
                 carry, jnp.asarray(rnd), jax.random.fold_in(root_key, rnd), data
             )
             if (rnd + 1) % sync_every == 0 or rnd == p.round_num - 1:
-                tl = float(carry[3][rnd])  # syncs the pipeline
-                elapsed = time.time() - t0
-                self.sync_log.append((rnd, elapsed))
-                msg = f"[round={rnd}] {elapsed:.1f}s train loss={tl:.6f}"
-                if has_test:
-                    msg += f" test loss={float(carry[4][rnd]):.6f}"
-                # watch-flag metrics at sync points (reference: EvalSet per
-                # round when watch_train/watch_test; here per sync so the
-                # enqueue pipeline stays deep between syncs)
-                # the final round skips the watch log: _finalize_device
-                # evaluates the same final scores anyway
-                if watch_eval is not None and rnd != p.round_num - 1:
-                    if p.watch_train:
-                        m = watch_eval.evaluate(
-                            loss_fn.predict(carry[0]), y, weight
-                        )
-                        msg += " train " + " ".join(
-                            f"{k}={v:.6f}" for k, v in m.items()
-                        )
-                    if p.watch_test and has_test:
-                        m = watch_eval.evaluate(
-                            loss_fn.predict(carry[1]), y_t, w_t
-                        )
-                        msg += " test " + " ".join(
-                            f"{k}={v:.6f}" for k, v in m.items()
-                        )
-                log.info(msg)
+                if watch_eval is None:
+                    nxt = (
+                        rnd,
+                        carry[3][rnd],
+                        carry[4][rnd] if has_test else None,
+                    )
+                    if pending is not None:
+                        self._emit_sync(pending, t0)
+                    pending = nxt
+                else:
+                    self._sync_report(rnd, carry, dd, watch_eval, t0)
             if p.model.dump_freq > 0 and (rnd + 1) % p.model.dump_freq == 0:
                 self._append_trees_from_bufs(
                     model, carry[2], bins, train.feature_names,
                     len(model.trees), (rnd + 1) * K,
                 )
                 self._dump_model(model)
+        if pending is not None:
+            self._emit_sync(pending, t0)
 
         if profile_dir:
             jax.block_until_ready(carry[0])
@@ -612,6 +653,44 @@ class GBDTTrainer:
             ),
         )
         return out
+
+    def _emit_sync(self, pending, t0) -> None:
+        """Materialize a lagged sync record (round, loss slice[, test])."""
+        rnd, loss_dev, tloss_dev = pending
+        tl = float(loss_dev)  # completed a window ago: one RTT, no stall
+        elapsed = time.time() - t0
+        self.sync_log.append((rnd, elapsed))
+        msg = f"[round={rnd}] {elapsed:.1f}s train loss={tl:.6f}"
+        if tloss_dev is not None:
+            msg += f" test loss={float(tloss_dev):.6f}"
+        log.info(msg)
+
+    def _sync_report(self, rnd: int, carry, dd: "_DevInputs", watch_eval, t0):
+        """Pipeline sync + progress line (+ watch-flag metrics at sync
+        points — reference: EvalSet per round when watch_train/watch_test;
+        here per sync so the enqueue pipeline stays deep between syncs).
+        The final round skips the watch log: _finalize_device evaluates
+        the same final scores anyway."""
+        p = self.params
+        tl = float(carry[3][rnd])  # syncs the pipeline
+        elapsed = time.time() - t0
+        self.sync_log.append((rnd, elapsed))
+        msg = f"[round={rnd}] {elapsed:.1f}s train loss={tl:.6f}"
+        has_test = dd.y_t is not None
+        if has_test:
+            msg += f" test loss={float(carry[4][rnd]):.6f}"
+        if watch_eval is not None and rnd != p.round_num - 1:
+            if p.watch_train:
+                m = watch_eval.evaluate(
+                    self.loss.predict(carry[0]), dd.y, dd.weight
+                )
+                msg += " train " + " ".join(f"{k}={v:.6f}" for k, v in m.items())
+            if p.watch_test and has_test:
+                m = watch_eval.evaluate(
+                    self.loss.predict(carry[1]), dd.y_t, dd.w_t
+                )
+                msg += " test " + " ".join(f"{k}={v:.6f}" for k, v in m.items())
+        log.info(msg)
 
     def _base_score(self, train: GBDTData, K: int):
         p = self.params
@@ -1108,18 +1187,9 @@ class GBDTTrainer:
             if tree.is_leaf(nid):
                 continue
             fid = tree.feat[nid]
-            lo = tree.slot[nid]
-            hi = int(tree.split[nid])
-            v = bins.values[fid]
-            if st == "median":
-                s = lo + hi
-                cond = (
-                    float(v[s // 2])
-                    if s % 2 == 0
-                    else 0.5 * (float(v[(s - 1) // 2]) + float(v[(s + 1) // 2]))
-                )
-            else:
-                cond = 0.5 * (float(v[lo]) + float(v[hi]))
+            cond = bins.split_value(
+                fid, tree.slot[nid], int(tree.split[nid]), split_type=st
+            )
             tree.split[nid] = cond
             # missing-value default direction from the fill value
             fill = self._missing_fill
