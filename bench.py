@@ -159,11 +159,17 @@ def bench_fm() -> dict:
     )
     reg = jnp.zeros((model.dim,), jnp.float32)
     w0 = jnp.asarray(model.init_weights())
+    # blocked loss+grad (optimize/blocked.py): the whole-batch latent gather
+    # at this scale is 39.9 GB lane-padded — the BENCH_r04 OOM; chunked it
+    # compiles at <4 GB total (AOT memory_analysis-verified on the v5e chip)
+    row_chunk = model.suggest_row_chunk(n, nnz)
+    print(f"fm row chunk: {row_chunk}", file=sys.stderr)
 
     def run(iters):
         res = minimize_lbfgs(
             model.pure_loss, w0, LBFGSConfig(max_iter=iters, m=8),
             batch=batch, l1_vec=reg, l2_vec=reg, g_weight=float(n),
+            row_chunk=row_chunk,
         )
         _ = float(res.loss)  # force completion through the device tunnel
         return res
@@ -196,11 +202,32 @@ def main() -> None:
         "logloss": round(g["logloss"], 4),
         "trees": g["trees"],
     }
+    # synthetic-task quality band (docs/bench.md): pinned from the r4
+    # hardware run at the default config (10.5M rows, 40 trees): AUC 0.9479
+    # / logloss 0.3158. Drift beyond ±0.005 AUC / ±0.02 logloss fails the
+    # run loudly (rc=1) — but only AFTER the JSON line is printed, so a
+    # quality regression never destroys the throughput artifact.
+    band_fail = None
+    quality_knobs = ("BENCH_ROWS", "BENCH_TEST_ROWS", "BENCH_TREES", "BENCH_WAVE", "BENCH_HIST")
+    if all(os.environ.get(k) is None for k in quality_knobs):
+        if abs(g["auc"] - 0.9479) > 0.005 or abs(g["logloss"] - 0.3158) > 0.02:
+            band_fail = (
+                f"auc {g['auc']:.4f} / logloss {g['logloss']:.4f} outside "
+                "band 0.9479±0.005 / 0.3158±0.02"
+            )
+        out["quality_band"] = band_fail or "ok"
     if os.environ.get("BENCH_FM", "1") != "0":
-        f = bench_fm()
-        out["fm_examples_per_sec"] = round(f["fm_examples_per_sec"])
-        out["fm_loss"] = round(f["fm_loss"], 4)
+        # the FM axis must never cost us the GBDT artifact again
+        # (the BENCH_r04 rc=1 lesson): axis failures are recorded, not raised
+        try:
+            f = bench_fm()
+            out["fm_examples_per_sec"] = round(f["fm_examples_per_sec"])
+            out["fm_loss"] = round(f["fm_loss"], 4)
+        except Exception as e:  # noqa: BLE001
+            out["fm_error"] = f"{type(e).__name__}: {e}"[:300]
     print(json.dumps(out))
+    if band_fail:
+        sys.exit(1)
 
 
 if __name__ == "__main__":

@@ -7,6 +7,13 @@
 # multiple-workers-on-one-host pattern the reference used for testing);
 # set YTK_SLAVE_HOSTS="host1 host2 ..." to launch ranks 1..N-1 over ssh.
 # Extra arguments pass through to `ytklearn_tpu.cli train` (e.g. --set).
+#
+# Master log: every rank's output is rank-labeled and appended to ONE
+# merged log (YTK_MASTER_LOG, default <repo>/log/master.log) — the
+# counterpart of the reference's comm.info/error forwarding to the
+# CommMaster log (reference: utils/LogUtils.java:33-65; monitoring recipe
+# `tail -f log/master.log | grep "train loss"` per docs/running_guide.md).
+# Remote ranks need no extra plumbing: their output rides the ssh pipe.
 set -euo pipefail
 
 REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
@@ -28,8 +35,16 @@ if ((${#slave_hosts[@]} > 0)) && [[ "${coordinator_host}" == "127.0.0.1" ]]; the
 fi
 coordinator="${coordinator_host}:${coordinator_port}"
 
-log_dir="$(mktemp -d /tmp/ytk_cluster.XXXXXX)"
-echo "rank logs: ${log_dir}" >&2
+master_log="${YTK_MASTER_LOG:-${REPO_ROOT}/log/master.log}"
+mkdir -p "$(dirname "${master_log}")"
+: >"${master_log}"
+echo "master log: ${master_log}" >&2
+
+# rank-label stdin lines and append to the master log; line-buffered so
+# concurrent appenders stay line-atomic (O_APPEND writes <= PIPE_BUF)
+label() {
+  awk -v tag="$1" '{ print "[" tag "] " $0; fflush() }' >>"${master_log}"
+}
 
 pids=()
 cleanup() {
@@ -44,15 +59,18 @@ for ((rank = num_procs - 1; rank >= 0; rank--)); do
        --coordinator "${coordinator}" --num-processes "${num_procs}"
        --process-id "${rank}" "$@")
   if ((rank == 0)); then
-    "${cmd[@]}"  # rank 0 foreground: serves the coordinator, prints results
+    # rank 0 foreground: serves the coordinator, prints results on stdout;
+    # its log stream (stderr) is tee'd into the master log AND kept on
+    # the console (the reference master also echoed its own log)
+    "${cmd[@]}" 2> >(tee >(label "rank 0") >&2)
   elif ((${#slave_hosts[@]} > 0)); then
     host="${slave_hosts[$(((rank - 1) % ${#slave_hosts[@]}))]}"
     remote_cmd="$(printf '%q ' "${cmd[@]}")"
     ssh "${host}" "cd $(printf '%q' "${REPO_ROOT}") && PYTHONPATH=$(printf '%q' "${REPO_ROOT}") ${remote_cmd}" \
-      >"${log_dir}/rank${rank}.log" 2>&1 &
+      > >(label "rank ${rank}") 2>&1 &
     pids+=($!)
   else
-    "${cmd[@]}" >"${log_dir}/rank${rank}.log" 2>&1 &
+    "${cmd[@]}" > >(label "rank ${rank}") 2>&1 &
     pids+=($!)
   fi
 done
@@ -65,4 +83,7 @@ for pid in "${pids[@]}"; do
   fi
 done
 pids=()  # clean exit: nothing left for the trap to kill
+# drain the process-substitution log writers (label/tee) so the master
+# log is complete before we exit — bash >= 5 waits procsubs on bare wait
+wait
 exit "${rc}"

@@ -168,6 +168,9 @@ class GBSTTrainer:
 
             w0 = model.init_weights(tree_seed=tree)
             batch = (idx, val, z, gmask, y, w_eff)
+            row_chunk = model.suggest_row_chunk(
+                int(idx.shape[0]), int(idx.shape[1]) if idx.ndim > 1 else 1
+            )
             res = minimize_lbfgs(
                 model.pure_loss,
                 self._put_rep(w0),
@@ -177,6 +180,9 @@ class GBSTTrainer:
                 l2_vec=l2_vec,
                 g_weight=g_weight,
                 callback=(lambda it, st: True) if p.loss.just_evaluate else None,
+                row_chunk=row_chunk,
+                row_mask=model.batch_row_mask,
+                mesh=self.mesh if row_chunk is not None else None,
             )
             per_tree_loss.append(res.loss / g_weight)
             if p.loss.just_evaluate:

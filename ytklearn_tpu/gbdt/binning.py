@@ -131,7 +131,14 @@ def _sample_feature(
             cs = 1 << 22
             for i in range(0, len(col), cs):
                 sk.push(col[i : i + cs], w[i : i + cs])
-            return sk.query_values(spec.max_cnt), False
+            # low-cardinality giant column: if no prune ever dropped
+            # entries, the summary is a perfect distinct-value table —
+            # keep the exact flag the sub-SKETCH_ROWS path would have set
+            # (it buys the multihost merge the cheap exact-union path)
+            summ = sk.summary()
+            if sk.is_exact and summ.size <= spec.max_cnt:
+                return summ.value.astype(np.float32), True
+            return summ.query_values(spec.max_cnt), False
         vals = np.unique(col)
         if len(vals) <= spec.max_cnt:
             return vals, True
@@ -272,10 +279,9 @@ def merge_bins_multihost(
         if discrete[f] or (all(exacts) and len(union) <= int(max_cnt_arr[f])):
             per_feature.append(union.astype(np.float32))
         elif all(f in g[3] for g in gathered):
-            merged = g0 = gathered[0][3][f]
+            merged = gathered[0][3][f]
             for g in gathered[1:]:
                 merged = merge_summaries(merged, g[3][f])
-            del g0
             per_feature.append(merged.query_values(int(max_cnt_arr[f])))
         else:
             per_feature.append(
@@ -319,20 +325,22 @@ def build_bins_global(
                 else np.ones_like(weight)
             )
             mass[f] = float(np.sum(w))
-            if not exact[f]:
-                # local GK summary for the bounded-error cross-process
-                # merge (pruned to 4*max_cnt: rank error <= B/(8*max_cnt),
-                # an eighth of the candidate spacing)
-                b = max(4 * int(spec.max_cnt), 256)
-                col = X[:, f]
-                if len(col) > SKETCH_ROWS:
-                    sk = WeightedQuantileSketch(b=b)
-                    cs = 1 << 22
-                    for i in range(0, len(col), cs):
-                        sk.push(col[i : i + cs], w[i : i + cs])
-                    summaries[f] = prune_summary(sk.summary(), b)
-                else:
-                    summaries[f] = prune_summary(Summary.from_exact(col, w), b)
+            # local GK summary for the bounded-error cross-process merge
+            # (pruned to 4*max_cnt: rank error <= B/(8*max_cnt), an eighth
+            # of the candidate spacing). Giant columns build one even when
+            # locally exact — another host's shard may be inexact, and
+            # without a summary on every host the merge would degrade to
+            # the unbounded candidate-union fallback.
+            b = max(4 * int(spec.max_cnt), 256)
+            col = X[:, f]
+            if len(col) > SKETCH_ROWS:
+                sk = WeightedQuantileSketch(b=b)
+                cs = 1 << 22
+                for i in range(0, len(col), cs):
+                    sk.push(col[i : i + cs], w[i : i + cs])
+                summaries[f] = prune_summary(sk.summary(), b)
+            elif not exact[f]:
+                summaries[f] = prune_summary(Summary.from_exact(col, w), b)
         else:
             discrete[f] = True  # discrete samplers merge by set union
             exact[f] = True

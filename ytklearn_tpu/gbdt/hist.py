@@ -228,7 +228,9 @@ def _hist_dense(bins_t, pos, g, h, node_ids, B: int, use_bf16: bool):
 
 
 def _pick_fg(F: int) -> int:
-    for fg in (7, 8, 4, 5, 6, 3, 2):
+    # wider groups amortize the per-step P/PV build further: fg=14 measured
+    # ~12% faster than fg=7 at the Higgs shape (r5, device-loop timing)
+    for fg in (14, 7, 8, 4, 5, 6, 3, 2):
         if F % fg == 0:
             return fg
     return 1

@@ -172,6 +172,21 @@ class WeightedQuantileSketch:
         self._buf_v: List[np.ndarray] = []
         self._buf_w: List[np.ndarray] = []
         self._buffered = 0
+        self._pruned = False  # True once any prune actually dropped entries
+
+    @property
+    def is_exact(self) -> bool:
+        """True while no prune has dropped entries — every distinct pushed
+        value is still in the summary with exact rank bounds (low-
+        cardinality columns never overflow b, so their sketch stays a
+        perfect distinct-value table)."""
+        return not self._pruned
+
+    def _prune(self, s: Summary) -> Summary:
+        out = prune_summary(s, self.b)
+        if out.size < s.size:
+            self._pruned = True
+        return out
 
     def push(self, values: np.ndarray, weights: Optional[np.ndarray] = None) -> None:
         values = np.asarray(values)
@@ -208,7 +223,7 @@ class WeightedQuantileSketch:
 
     def _flush_chunk(self) -> None:
         v, w = self._take_chunk()
-        s = prune_summary(Summary.from_exact(v, w), self.b)
+        s = self._prune(Summary.from_exact(v, w))
         lvl = 0
         while True:
             if lvl == len(self.levels):
@@ -217,7 +232,7 @@ class WeightedQuantileSketch:
             if self.levels[lvl] is None:
                 self.levels[lvl] = s
                 break
-            s = prune_summary(merge_summaries(self.levels[lvl], s), self.b)
+            s = self._prune(merge_summaries(self.levels[lvl], s))
             self.levels[lvl] = None
             lvl += 1
 
@@ -228,7 +243,7 @@ class WeightedQuantileSketch:
         if self._buffered:
             v = np.concatenate(self._buf_v)
             w = np.concatenate(self._buf_w)
-            parts.append(prune_summary(Summary.from_exact(v, w), self.b))
+            parts.append(self._prune(Summary.from_exact(v, w)))
         if not parts:
             return Summary.from_exact(np.zeros(0), np.zeros(0))
         out = parts[0]

@@ -598,7 +598,9 @@ class GBDTTrainer:
         # ago, so the float() costs one RTT of host time with zero device
         # idle (the queue stays ~2 windows deep; watch mode keeps the
         # synchronous path since its metric evals fetch eagerly anyway)
-        pending: Optional[Tuple[int, jnp.ndarray, Optional[jnp.ndarray]]] = None
+        pending: Optional[
+            Tuple[int, jnp.ndarray, Optional[jnp.ndarray], float]
+        ] = None
         for rnd in range(start_round, p.round_num):
             carry = jit_round(
                 carry, jnp.asarray(rnd), jax.random.fold_in(root_key, rnd), data
@@ -609,6 +611,7 @@ class GBDTTrainer:
                         rnd,
                         carry[3][rnd],
                         carry[4][rnd] if has_test else None,
+                        time.time(),  # sync-point host time, not emission
                     )
                     if pending is not None:
                         self._emit_sync(pending, t0)
@@ -655,10 +658,14 @@ class GBDTTrainer:
         return out
 
     def _emit_sync(self, pending, t0) -> None:
-        """Materialize a lagged sync record (round, loss slice[, test])."""
-        rnd, loss_dev, tloss_dev = pending
+        """Materialize a lagged sync record (round, loss slice[, test]).
+        The logged time is the round's sync-point host timestamp carried in
+        `pending` — emission happens one window later, which would skew
+        absolute per-round times late (steady-state trees/s uses diffs and
+        is insensitive either way)."""
+        rnd, loss_dev, tloss_dev, t_sync = pending
         tl = float(loss_dev)  # completed a window ago: one RTT, no stall
-        elapsed = time.time() - t0
+        elapsed = t_sync - t0
         self.sync_log.append((rnd, elapsed))
         msg = f"[round={rnd}] {elapsed:.1f}s train loss={tl:.6f}"
         if tloss_dev is not None:

@@ -69,9 +69,30 @@ class ConvexModel:
         return jnp.asarray(l1v), jnp.asarray(l2v)
 
     # batches ------------------------------------------------------------
+    #: which make_batch elements are row-aligned (None = all); models with
+    #: broadcast batch elements (e.g. the GBST gate mask) override this so
+    #: blocked evaluation (optimize/blocked.py) chunks only row arrays
+    batch_row_mask: Optional[Tuple[bool, ...]] = None
+
     def make_batch(self, ds: SparseDataset) -> Tuple[np.ndarray, ...]:
         """(idx, val, y, weight) padded-ELL by default; all arrays row-shard."""
         return (ds.idx, ds.val, ds.y, ds.weight)
+
+    def score_bytes_per_row(self, width: int) -> int:
+        """Approximate padded bytes of per-row score intermediates under the
+        TPU (8,128) tiled layout — drives row-chunk selection. Subclasses
+        with latent gathers (FM/FFM/GBST) override with their real cost."""
+        return -(-width // 128) * 128 * 4
+
+    def suggest_row_chunk(self, n_rows: int, width: int) -> Optional[int]:
+        """Row chunk for blocked loss/grad/score evaluation, or None when
+        the whole batch fits the budget (the reference's blocked-CoreData
+        contract, dataflow/CoreData.java:51-52; env overrides YTK_ROW_CHUNK
+        / YTK_CHUNK_BUDGET_MB)."""
+        from ..optimize.blocked import suggest_chunk
+
+        # x4: forward intermediate + its backward cotangents/temps
+        return suggest_chunk(n_rows, 4 * self.score_bytes_per_row(width))
 
     # kernels ------------------------------------------------------------
     def pure_loss(self, w, *batch):

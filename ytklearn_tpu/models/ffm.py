@@ -105,6 +105,13 @@ class FFMModel(ConvexModel):
         diag = jnp.sum((val * val) * jnp.sum(own * own, axis=-1), axis=-1)
         return wx + 0.5 * (cross - diag)
 
+    def score_bytes_per_row(self, width: int) -> int:
+        """Dominant per-row intermediates: the latent gather (width, F, k)
+        and the field-pair tensor (F, F, k), both k-minor (pad k->128)."""
+        F, kp = self.n_fields, -(-max(self.sok, 1) // 128) * 128
+        Fp = -(-F // 8) * 8
+        return (width * Fp + F * Fp) * kp * 4
+
     # -- model text I/O: name,w,v[field0 k..],v[field1 k..],... ----------
 
     def model_line(self, name, i, w, precision, is_bias):

@@ -73,11 +73,19 @@ class FMModel(ConvexModel):
         wx = jnp.sum(val * w[: self.v_start][idx], axis=-1)
         if not self.need_second_order:
             return wx
-        V = w[self.v_start :].reshape(self.n_features, self.sok)
-        vx = V[idx] * val[..., None]  # (n, width, k)
-        S = jnp.sum(vx, axis=1)  # Σ v x
-        S2 = jnp.sum(vx * vx, axis=1)  # Σ (v x)^2
-        return wx + 0.5 * jnp.sum(S * S - S2, axis=-1)
+        # k-major latent gather: the (k, n, width) intermediate keeps width
+        # on the 128-lane axis (pad e.g. 39->128, ~3.3x) instead of k
+        # (8->128, 16x) — the k-minor layout is what OOM'd BENCH_r04
+        # (f32[2M*39,8] lane-padded to 39.9 GB)
+        Vt = w[self.v_start :].reshape(self.n_features, self.sok).T  # (k, nf)
+        vx = Vt[:, idx] * val[None]  # (k, n, width)
+        S = jnp.sum(vx, axis=-1)  # Σ v x            (k, n)
+        S2 = jnp.sum(vx * vx, axis=-1)  # Σ (v x)^2  (k, n)
+        return wx + 0.5 * jnp.sum(S * S - S2, axis=0)
+
+    def score_bytes_per_row(self, width: int) -> int:
+        wp = -(-width // 128) * 128
+        return max(self.sok, 1) * wp * 4
 
     # -- model text I/O: name,w,v1,...,vk --------------------------------
 

@@ -111,6 +111,17 @@ class GBSTModel(ConvexModel):
                 w[: 2 * K - 1] = 0.0  # bias feature's whole block
         return w
 
+    #: boost.py batch layout (idx, val, z, gate_mask, y, weight) — the gate
+    #: mask is per-feature, not per-row
+    batch_row_mask = (True, True, True, False, True, True)
+
+    def score_bytes_per_row(self, width: int) -> int:
+        """Dominant per-row intermediate: the (width, 2K-1) weight gather
+        (k-minor, pads 2K-1 -> 128)."""
+        wp = -(-width // 8) * 8
+        stride = 2 * self.K - 1 if not self.scalar_leaves else self.K - 1
+        return wp * (-(-stride // 128) * 128) * 4
+
     # -- kernels ---------------------------------------------------------
 
     def tree_output(self, w, idx, val, gate_mask):

@@ -153,10 +153,12 @@ def inv_hessian_vp(state: LBFGSState, v, m: int):
     )
 
 
-def _loss_grad(pure_loss_fn, has_l1: bool, w, reg: Reg, batch):
+def _loss_grad(vg_fn, has_l1: bool, w, reg: Reg, batch):
     """calcLossAndGrad equivalent (reference: HoagOptimizer.java:978-1066).
+    vg_fn(w, *batch) -> (pure_loss, grad) — plain value_and_grad or the
+    row-chunked variant (optimize/blocked.py).
     -> (pure_loss, all_loss, pseudo_grad)."""
-    pure, G = jax.value_and_grad(pure_loss_fn)(w, *batch)
+    pure, G = vg_fn(w, *batch)
     gw = reg.g_weight
     all_loss = pure + 0.5 * gw * jnp.sum(reg.l2_vec * w * w)
     G = G + gw * reg.l2_vec * w
@@ -171,10 +173,11 @@ def _loss_grad(pure_loss_fn, has_l1: bool, w, reg: Reg, batch):
     return pure, all_loss, G
 
 
-# program cache: (pure_loss_fn, trace-relevant config fields, has_l1) ->
-# (first_eval, iteration). max_iter/eps only drive the host loop and must
-# not key the cache (they'd force pointless recompiles). Bounded LRU so a
-# long-lived process sweeping many models doesn't pin executables forever.
+# program cache: (pure_loss_fn, trace-relevant config fields, has_l1,
+# chunking) -> (first_eval, iteration). max_iter/eps only drive the host
+# loop and must not key the cache (they'd force pointless recompiles).
+# Bounded LRU so a long-lived process sweeping many models doesn't pin
+# executables forever.
 from collections import OrderedDict
 
 _PROGRAMS: "OrderedDict" = OrderedDict()
@@ -195,8 +198,17 @@ def _trace_key(config: LBFGSConfig):
     )
 
 
-def _build_programs(pure_loss_fn, config: LBFGSConfig, has_l1: bool):
-    key = (pure_loss_fn, _trace_key(config), has_l1)
+def _build_programs(
+    pure_loss_fn,
+    config: LBFGSConfig,
+    has_l1: bool,
+    row_chunk=None,
+    row_mask=None,
+    mesh=None,
+    data_axis="data",
+    n_batch=0,
+):
+    key = (pure_loss_fn, _trace_key(config), has_l1, row_chunk, row_mask, mesh)
     hit = _PROGRAMS.get(key)
     if hit is not None:
         _PROGRAMS.move_to_end(key)
@@ -205,7 +217,12 @@ def _build_programs(pure_loss_fn, config: LBFGSConfig, has_l1: bool):
     m = config.m
     mode = _MODES[config.mode]
     c1, c2 = config.c1, config.c2
-    lg = partial(_loss_grad, pure_loss_fn, has_l1)
+    from .blocked import make_value_and_grad
+
+    vg_fn = make_value_and_grad(
+        pure_loss_fn, row_chunk, row_mask, mesh, data_axis, n_batch
+    )
+    lg = partial(_loss_grad, vg_fn, has_l1)
 
     def orthant_project(l1v, w_try, wprev, gprev):
         """reference: lineSearch orthant block :1089-1103."""
@@ -351,6 +368,10 @@ def minimize_lbfgs(
     l2_vec: Optional[jnp.ndarray] = None,
     g_weight: float = 1.0,
     callback: Optional[Callable[[int, LBFGSState], bool]] = None,
+    row_chunk: Optional[int] = None,
+    row_mask: Optional[Tuple[bool, ...]] = None,
+    mesh=None,
+    data_axis: str = "data",
 ) -> LBFGSResult:
     """Run distributed L-BFGS/OWL-QN to convergence.
 
@@ -358,6 +379,12 @@ def minimize_lbfgs(
     (jit-safe; batch arrays may be sharded over a mesh — XLA inserts the
     psums the reference issued by hand at HoagOptimizer.java:1014,1038).
     Pass the SAME function object across calls to reuse compiled programs.
+
+    row_chunk: evaluate loss+grad as a scan over row chunks of this size so
+    peak memory is O(chunk) — the reference's blocked-CoreData contract
+    (dataflow/CoreData.java:51-52; see optimize/blocked.py). row_mask marks
+    which batch elements are row-aligned (default: all). With `mesh`, the
+    chunked scan runs per-shard under shard_map over `data_axis` + psum.
 
     callback(iter, state) runs on host once per iteration (eval/dump hook —
     the reference's per-iteration eval + dump_freq block :605-660); returning
@@ -375,7 +402,16 @@ def minimize_lbfgs(
         ),
         g_weight=jnp.asarray(g_weight, dtype),
     )
-    first_eval, iteration = _build_programs(pure_loss_fn, config, has_l1)
+    first_eval, iteration = _build_programs(
+        pure_loss_fn,
+        config,
+        has_l1,
+        row_chunk=row_chunk,
+        row_mask=row_mask,
+        mesh=mesh,
+        data_axis=data_axis,
+        n_batch=len(batch),
+    )
 
     pure, loss, g, wnorm, gnorm = first_eval(jnp.asarray(w0, dtype), reg, batch)
     wnorm = max(float(wnorm), 1.0)

@@ -178,8 +178,25 @@ class HoagTrainer:
             if p.loss.evaluate_metric
             else None
         )
-        jit_loss = jax.jit(model.pure_loss)
-        jit_predicts = jax.jit(model.predicts)
+        # blocked evaluation: chunk row arrays so per-row score
+        # intermediates (FM/FFM latent gathers) never scale peak memory
+        # with n (reference blocked-CoreData contract, CoreData.java:51-52)
+        width = int(train_b[0].shape[1]) if train_b[0].ndim > 1 else 1
+        row_chunk = model.suggest_row_chunk(int(train_b[0].shape[0]), width)
+        row_mask = model.batch_row_mask
+        # mesh-aware when sharded: chunks stay shard-local (a plain scan on
+        # a row-sharded array would all-gather the batch onto every device)
+        from .optimize.blocked import make_rows, make_sum, make_value_and_grad
+
+        if row_chunk is not None:
+            log.info("blocked evaluation: row chunk %d", row_chunk)
+        nb = len(train_b)
+        jit_loss = jax.jit(
+            make_sum(model.pure_loss, row_chunk, row_mask, self.mesh, "data", nb)
+        )
+        jit_predicts = jax.jit(
+            make_rows(model.predicts, row_chunk, row_mask, self.mesh, "data", nb)
+        )
         jit_precision = (
             jax.jit(model.precision) if hasattr(model, "precision") else None
         )
@@ -229,7 +246,11 @@ class HoagTrainer:
             hoag_grad_hist: List[np.ndarray] = []
             hoag_delta_hist: List[float] = []
             hoag_t_old = 0.0
-            jit_grad_test = jax.jit(jax.grad(model.pure_loss))
+            _cvg = make_value_and_grad(
+                model.pure_loss, row_chunk, row_mask, self.mesh, "data",
+                len(test_b),
+            )
+            jit_grad_test = jax.jit(lambda w, *b: _cvg(w, *b)[1])
         else:
             if p.hyper.switch_on:
                 log.warning(
@@ -295,6 +316,9 @@ class HoagTrainer:
                 l2_vec=l2_vec,
                 g_weight=g_weight,
                 callback=callback,
+                row_chunk=row_chunk,
+                row_mask=row_mask,
+                mesh=self.mesh if row_chunk is not None else None,
             )
             carry_w = np.asarray(res.w)
             # round selection: test loss when available, else the *pure*
