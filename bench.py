@@ -82,7 +82,8 @@ def bench_gbdt() -> dict:
     n = int(os.environ.get("BENCH_ROWS", 10_500_000))
     n_test = int(os.environ.get("BENCH_TEST_ROWS", 500_000))
     n_trees = int(os.environ.get("BENCH_TREES", 40))
-    wave = int(os.environ.get("BENCH_WAVE", 32))
+    wave_env = os.environ.get("BENCH_WAVE")
+    wave = int(wave_env) if wave_env else None  # None = trainer default (64)
     hist = os.environ.get("BENCH_HIST", "int8")
 
     t0 = time.time()
@@ -101,8 +102,9 @@ def bench_gbdt() -> dict:
         approximate=[ApproximateSpec(type="sample_by_quantile", max_cnt=255)],
         model=ModelParams(data_path="/tmp/bench_gbdt_model", dump_freq=0),
     )
-    # int8 histogram quantization (2x MXU rate) + wave 32: measured at this
-    # config vs bf16 — test-AUC delta 0.0002 at 60 trees, ~1.2x throughput
+    # int8 histogram quantization (2x MXU rate): measured at this config vs
+    # bf16 — test-AUC delta 0.0002 at 60 trees, ~1.2x throughput. Wave
+    # width defaults to the trainer's 64 (r5: 1.218 vs 1.160 trees/s at 32)
     trainer = GBDTTrainer(params, engine="device", hist_precision=hist, wave=wave)
     res = trainer.train(train=train, test=test)
     assert np.isfinite(res.train_loss) and res.train_loss < 0.65
@@ -203,17 +205,17 @@ def main() -> None:
         "trees": g["trees"],
     }
     # synthetic-task quality band (docs/bench.md): pinned from the r4
-    # hardware run at the default config (10.5M rows, 40 trees): AUC 0.9479
-    # / logloss 0.3158. Drift beyond ±0.005 AUC / ±0.02 logloss fails the
+    # hardware run at the default config (10.5M rows, 40 trees, wave 64):
+    # AUC 0.9489 / logloss 0.3118. Drift beyond ±0.005 AUC / ±0.02 logloss fails the
     # run loudly (rc=1) — but only AFTER the JSON line is printed, so a
     # quality regression never destroys the throughput artifact.
     band_fail = None
     quality_knobs = ("BENCH_ROWS", "BENCH_TEST_ROWS", "BENCH_TREES", "BENCH_WAVE", "BENCH_HIST")
     if all(os.environ.get(k) is None for k in quality_knobs):
-        if abs(g["auc"] - 0.9479) > 0.005 or abs(g["logloss"] - 0.3158) > 0.02:
+        if abs(g["auc"] - 0.9489) > 0.005 or abs(g["logloss"] - 0.3118) > 0.02:
             band_fail = (
                 f"auc {g['auc']:.4f} / logloss {g['logloss']:.4f} outside "
-                "band 0.9479±0.005 / 0.3158±0.02"
+                "band 0.9489±0.005 / 0.3118±0.02"
             )
         out["quality_band"] = band_fail or "ok"
     if os.environ.get("BENCH_FM", "1") != "0":

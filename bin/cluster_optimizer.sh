@@ -84,6 +84,14 @@ for pid in "${pids[@]}"; do
 done
 pids=()  # clean exit: nothing left for the trap to kill
 # drain the process-substitution log writers (label/tee) so the master
-# log is complete before we exit — bash >= 5 waits procsubs on bare wait
+# log is complete before we exit — bash >= 5.1 waits procsubs on bare
+# wait; the mtime poll bounds the wait for older bash, where procsub
+# pids are not exposed and bare wait returns immediately
 wait
+for _ in 1 2 3 4 5 6 7 8 9 10; do
+  m1="$(stat -c %Y "${master_log}" 2>/dev/null || stat -f %m "${master_log}")"
+  sleep 0.2
+  m2="$(stat -c %Y "${master_log}" 2>/dev/null || stat -f %m "${master_log}")"
+  [[ "${m1}" == "${m2}" ]] && break
+done
 exit "${rc}"

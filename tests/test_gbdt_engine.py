@@ -214,3 +214,49 @@ def test_engine_sharded_f32_quality(tmp_path, mesh8, policy):
     assert res8.train_metrics["auc"] == pytest.approx(
         res1.train_metrics["auc"], abs=0.005
     )
+
+
+def test_partitioned_hist_matches_full_scan(tmp_path, monkeypatch):
+    """Leaf-partitioned histogram passes (GrowSpec.partition — per-wave row
+    compaction + gathered-budget kernels) must grow IDENTICAL trees to the
+    full-scan path: the same rows enter every histogram, and in int8 mode
+    the i32 sums are order-independent, so equality is exact."""
+    data = _data(n=3000)
+    p_on = _params(tmp_path / "on", "loss", round_num=3, max_leaf_cnt=24)
+    p_off = _params(tmp_path / "off", "loss", round_num=3, max_leaf_cnt=24)
+    (tmp_path / "on").mkdir()
+    (tmp_path / "off").mkdir()
+    monkeypatch.delenv("YTK_NO_PARTITION", raising=False)
+    monkeypatch.setenv("YTK_PARTITION", "1")  # explicit: also real on a TPU
+    res_on = GBDTTrainer(
+        p_on, engine="device", wave=8, hist_precision="int8"
+    ).train(train=data)
+    monkeypatch.setenv("YTK_NO_PARTITION", "1")
+    res_off = GBDTTrainer(
+        p_off, engine="device", wave=8, hist_precision="int8"
+    ).train(train=data)
+    assert len(res_on.model.trees) == len(res_off.model.trees)
+    for t_on, t_off in zip(res_on.model.trees, res_off.model.trees):
+        assert _tree_sig(t_on) == _tree_sig(t_off)
+        assert t_on.sample_cnt == t_off.sample_cnt
+    assert res_on.train_loss == pytest.approx(res_off.train_loss, rel=1e-6)
+
+
+def test_partitioned_hist_sharded(tmp_path, mesh8, monkeypatch):
+    """Partitioned hist under shard_map: shard-local budget choice with the
+    psum_scatter outside the ladder conds — 8-device trees must still equal
+    the single-device int8 trees exactly."""
+    monkeypatch.delenv("YTK_NO_PARTITION", raising=False)
+    monkeypatch.setenv("YTK_PARTITION", "1")  # explicit: also real on a TPU
+    p1 = _params(tmp_path / "one", "loss", round_num=2, max_leaf_cnt=16)
+    p8 = _params(tmp_path / "eight", "loss", round_num=2, max_leaf_cnt=16)
+    (tmp_path / "one").mkdir()
+    (tmp_path / "eight").mkdir()
+    res1 = GBDTTrainer(
+        p1, engine="device", wave=4, hist_precision="int8"
+    ).train(train=_data(n=2560))
+    res8 = GBDTTrainer(
+        p8, mesh=mesh8, engine="device", wave=4, hist_precision="int8"
+    ).train(train=_data(n=2560))
+    for t1, t8 in zip(res1.model.trees, res8.model.trees):
+        assert _tree_sig(t1) == _tree_sig(t8)

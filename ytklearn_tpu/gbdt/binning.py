@@ -339,7 +339,11 @@ def build_bins_global(
                 for i in range(0, len(col), cs):
                     sk.push(col[i : i + cs], w[i : i + cs])
                 summaries[f] = prune_summary(sk.summary(), b)
-            elif not exact[f]:
+            else:
+                # unconditional: a locally-exact shard still needs a summary
+                # — another host's shard of the same column may be inexact,
+                # and the bounded-error merge requires summaries on EVERY
+                # host (exact Summaries are small and exact by construction)
                 summaries[f] = prune_summary(Summary.from_exact(col, w), b)
         else:
             discrete[f] = True  # discrete samplers merge by set union

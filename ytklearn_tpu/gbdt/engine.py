@@ -165,7 +165,18 @@ class GrowSpec:
     bm: int = 16384  # keep in sync with hist.BM_DEFAULT (trainer padding)
     use_bf16: bool = True
     force_dense: bool = False
-    hist_mode: str = "mxu"  # "mxu" (bf16/f32 per use_bf16) | "int8" 
+    hist_mode: str = "mxu"  # "mxu" (bf16/f32 per use_bf16) | "int8"
+    # leaf-partitioned histogram passes: once the frontier's waves need few
+    # rows, compact the smaller-child rows into a static budget and
+    # histogram only those — wave cost scales with rows-in-wave instead of
+    # all n (the LightGBM data-partition idea; reference hot loop
+    # HistogramBuilder.java:72-90 likewise iterates node intervals only).
+    # `ladder` lists the budget divisors; growth runs as phase-separated
+    # while_loops (full scan while waves are big, then each budget, then a
+    # full-scan safety tail) because lax.cond around Mosaic kernels is a
+    # compile catastrophe on the current toolchain.
+    partition: bool = True
+    ladder: Tuple[int, ...] = (8, 32)
 
     @property
     def depth_cap(self) -> int:
@@ -329,6 +340,27 @@ def _build_grow(spec: GrowSpec, n_shards: int = 1, axis: str = "data"):
         n = bins_t.shape[1]
         pos = jnp.zeros((n,), jnp.int32)
         aux_pos = tuple(jnp.zeros((bt.shape[1],), jnp.int32) for bt in aux)
+        iota_n = jnp.arange(n, dtype=jnp.int32)
+
+        # leaf-partition budget ladder (static shapes, ascending): a wave
+        # hists only smaller children, so ceil(n/2) always fits budget 0
+        use_part = spec.partition
+        unit = 128 if spec.force_dense else spec.bm
+        if use_part:
+            R_list = []
+            for div in spec.ladder:
+                want = -(-n // div)  # ceil(n / div)
+                R = max(-(-want // unit) * unit, unit)
+                if R < n and R not in R_list:
+                    R_list.append(R)
+            R_list.sort()
+            use_part = bool(R_list)
+        if use_part:
+            # row-major copy for the per-wave row gather (shard-local under
+            # shard_map; materialized once per tree, ~n*F bytes at u8)
+            bins_rows = jnp.transpose(bins_t)
+            if spec.B <= 256:
+                bins_rows = bins_rows.astype(jnp.uint8)
 
         # tile once per tree: the Pallas kernels want (F, nblk, 1, bm); done
         # inside the wave loop XLA re-materializes the tiled copy EVERY wave
@@ -362,25 +394,63 @@ def _build_grow(spec: GrowSpec, n_shards: int = 1, axis: str = "data"):
             gq = jnp.clip(jnp.round(g * sg), -qmax, qmax)  # f32 integers:
             hq = jnp.clip(jnp.round(h * sh), -qmax, qmax)  # kernel casts to i8
             inv = jnp.stack([1.0 / sg, 1.0 / sh, jnp.asarray(1.0)])
+            G_, H_ = gq, hq
 
-            def hist_call(pos_fit, ids):
-                hq_i32 = hist_wave_q(
-                    bins_k, pos_fit, gq, hq, ids, B,
+            def hist_partial(bins_in, pos_v, g_v, h_v, ids):
+                return hist_wave_q(
+                    bins_in, pos_v, g_v, h_v, ids, B,
                     bm=spec.bm, force_dense=spec.force_dense,
                 )  # (N, F, B, 3) i32 partial
-                hq_i32 = combine_hist(hq_i32)  # (N, F_loc, B, 3) global sum
-                return hq_i32.astype(jnp.float32) * inv[None, None, None, :]
+
+            def hist_finish(partial_h):
+                summed = combine_hist(partial_h)  # (N, F_loc, B, 3) global
+                return summed.astype(jnp.float32) * inv[None, None, None, :]
 
         else:
+            G_, H_ = g, h
 
-            def hist_call(pos_fit, ids):
-                return combine_hist(
-                    hist_wave(
-                        bins_k, pos_fit, g, h, ids, B,
-                        bm=spec.bm, use_bf16=spec.use_bf16,
-                        force_dense=spec.force_dense,
-                    )
+            def hist_partial(bins_in, pos_v, g_v, h_v, ids):
+                return hist_wave(
+                    bins_in, pos_v, g_v, h_v, ids, B,
+                    bm=spec.bm, use_bf16=spec.use_bf16,
+                    force_dense=spec.force_dense,
                 )
+
+            def hist_finish(partial_h):
+                return combine_hist(partial_h)
+
+        def hist_call(pos_fit, ids):
+            """Full-scan histogram (root + slow start + big-wave phases)."""
+            return hist_finish(hist_partial(bins_k, pos_fit, G_, H_, ids))
+
+        def hist_budget(R: int):
+            """Leaf-partitioned histogram at static budget R: compact the
+            rows belonging to the wave's nodes, gather their bins/grads,
+            and run the SAME kernel on R rows instead of n. The phase
+            loop's condition guarantees the wave needs <= R rows. (This is
+            deliberately cond-free: lax.cond around a Mosaic kernel takes
+            >10 min to compile on this toolchain — phase-separated
+            while_loops select the budget instead.)"""
+
+            def call(pos_fit, ids):
+                mask = jnp.zeros(pos_fit.shape, bool)
+                for k in range(int(ids.shape[0])):  # static width unroll
+                    mask = mask | (pos_fit == ids[k])
+                csum = jnp.cumsum(mask.astype(jnp.int32))
+                cnt = csum[-1]
+                dest = jnp.where(mask, csum - 1, R)
+                idx = jnp.zeros((R,), jnp.int32).at[dest].set(iota_n, mode="drop")
+                valid = jnp.arange(R, dtype=jnp.int32) < cnt
+                pg = jnp.where(valid, jnp.take(pos_fit, idx), -1)
+                gg = jnp.take(G_, idx)
+                hg = jnp.take(H_, idx)
+                bg = jnp.take(bins_rows, idx, axis=0)  # (R, F) u8
+                bt = jnp.transpose(bg).astype(jnp.int32)
+                if not spec.force_dense:
+                    bt = bt.reshape(F, R // spec.bm, 1, spec.bm)
+                return hist_finish(hist_partial(bt, pg, gg, hg, ids))
+
+            return call
 
         tr = TreeArrays(
             feat=jnp.full((M,), -1, jnp.int32),
@@ -441,10 +511,23 @@ def _build_grow(spec: GrowSpec, n_shards: int = 1, axis: str = "data"):
             tr, fr, pool, pos, aux_pos, leaves = state
             return jnp.any(can_split(fr, tr, leaves))
 
-        def make_body(nw: int):
-            return lambda state: wave_body(state, nw)
+        def wave_need(state):
+            """Exact row count the NEXT wave's histograms touch: the sum of
+            smaller-child counts over the nodes the selection would pick.
+            Drives the phase-loop budget transitions (computed from frontier
+            stats — C-channel counts match the compaction mask exactly)."""
+            tr, fr, pool, pos, aux_pos, leaves = state
+            ok = can_split(fr, tr, leaves)
+            sel, sel_ok = select(ok, fr, tr, NW)
+            order_cum = jnp.cumsum(sel_ok.astype(jnp.int32), dtype=jnp.int32)
+            sel_ok &= (leaves + order_cum) <= spec.leaf_cap
+            small_cnt = jnp.minimum(fr.CL[sel], fr.CR[sel])
+            return jnp.sum(jnp.where(sel_ok, small_cnt, 0.0))
 
-        def wave_body(state, nw: int):
+        def make_body(nw: int, hist_fn=None):
+            return lambda state: wave_body(state, nw, hist_fn)
+
+        def wave_body(state, nw: int, hist_fn=None):
             tr, fr, pool, pos, aux_pos, leaves = state
             ok = can_split(fr, tr, leaves)
             sel, sel_ok = select(ok, fr, tr, nw)
@@ -517,7 +600,7 @@ def _build_grow(spec: GrowSpec, n_shards: int = 1, axis: str = "data"):
             big = jnp.where(CLs <= CRs, rch, lch)
             ids = jnp.where(sel_ok, small, -2)
             pos_fit = jnp.where(include, pos, -1)
-            h_small = hist_call(pos_fit, ids)
+            h_small = (hist_fn or hist_call)(pos_fit, ids)
             parent_h = pool[nid]
             h_big = parent_h - h_small
             pool = pool.at[jnp.where(sel_ok, small, M)].set(h_small, **drop)
@@ -555,9 +638,48 @@ def _build_grow(spec: GrowSpec, n_shards: int = 1, axis: str = "data"):
         while nw_ss < NW:
             state = wave_body(state, nw_ss)
             nw_ss *= 2
-        tr, fr, pool, pos, aux_pos, leaves = jax.lax.while_loop(
-            cond, make_body(NW), state
-        )
+
+        if use_part:
+            # phase-separated growth: full scans while waves are big, then
+            # tighter partitioned budgets as the frontier's row need
+            # shrinks, then a full-scan tail for any non-monotone leftovers
+            # (need is near-monotone decreasing under gain-ordered
+            # selection; the tail keeps pathological orders correct)
+            Rs = sorted(R_list, reverse=True)  # big -> small
+
+            def mk_cond(lo, hi):
+                # `need` is the GLOBAL wave row count (frontier stats are
+                # merged/replicated across shards) compared against the
+                # LOCAL budget R: global need <= local budget implies every
+                # shard's local rows fit — conservative under a mesh (a
+                # shard transitions ~D x later than its own load requires)
+                # but never drops rows, and exact on one device.
+                def cond_fn(state):
+                    c = cond(state)
+                    need = wave_need(state)
+                    if hi is not None:
+                        c &= need <= hi
+                    if lo is not None:
+                        c &= need > lo
+                    return c
+
+                return cond_fn
+
+            state = jax.lax.while_loop(
+                mk_cond(Rs[0], None), make_body(NW), state
+            )
+            for i, R in enumerate(Rs):
+                nxt = Rs[i + 1] if i + 1 < len(Rs) else None
+                state = jax.lax.while_loop(
+                    mk_cond(nxt, R), make_body(NW, hist_budget(R)), state
+                )
+            tr, fr, pool, pos, aux_pos, leaves = jax.lax.while_loop(
+                cond, make_body(NW), state
+            )
+        else:
+            tr, fr, pool, pos, aux_pos, leaves = jax.lax.while_loop(
+                cond, make_body(NW), state
+            )
         return tr, pos, aux_pos
 
     return grow

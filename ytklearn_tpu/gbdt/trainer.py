@@ -290,10 +290,13 @@ class GBDTTrainer:
         if self.wave is not None:
             NW = self.wave
         else:
-            # loss policy: 32 measured fastest at Higgs scale (wave cost is
-            # ~flat in slot count until ~2 MXU row-tiles; wider waves halve
-            # the full-data passes)
-            NW = 64 if p.tree_grow_policy == "level" else 32
+            # 64 measured fastest at Higgs scale (r5, 40-tree runs: 1.218
+            # vs 1.160 trees/s at 32, quality inside the band): the hist
+            # kernel is VPU-bound on the one-hot builds at narrow waves —
+            # (4N+B)*bm VPU ops vs 3N*B*bm MACs per block — so wider waves
+            # raise MXU utilization; 128 over-relaxes best-first and pays
+            # for unused frontier slots (1.098 trees/s, worse AUC)
+            NW = 64
         NW = max(1, min(NW, (M + 1) // 2))
         # dense einsum only where Mosaic can't compile (CPU tests / virtual
         # mesh); mesh>1 runs the SAME Pallas kernels per shard under
@@ -317,6 +320,17 @@ class GBDTTrainer:
             use_bf16=self.use_bf16_hist,
             force_dense=force_dense,
             hist_mode="int8" if self.hist_precision == "int8" else "mxu",
+            # leaf-partitioned hist passes: opt-in on TPU while the phase
+            # thresholds are tuned (YTK_PARTITION=1; correctness is
+            # equivalence-tested either way). On CPU (dense kernels) the
+            # partitioned path is the default. YTK_NO_PARTITION=1 always
+            # wins so an A/B "off" run can never silently run partitioned.
+            partition=(
+                os.environ.get("YTK_NO_PARTITION") != "1"
+                and (
+                    os.environ.get("YTK_PARTITION") == "1" or force_dense
+                )
+            ),
         )
 
     def _prep_device_inputs(self, train: GBDTData, test: Optional[GBDTData]):
