@@ -448,40 +448,10 @@ class GBDTTrainer:
             del bins_dev, bins_test_dev
         return scores, scores_t
 
-    def _train_device(
-        self, train: Optional[GBDTData], test: Optional[GBDTData]
-    ) -> GBDTResult:
+    def _make_tree_bufs(self, M: int):
+        """Whole-run tree buffers, written on device, fetched once."""
         p = self.params
-        t0 = time.time()
-        ts = self.time_stats = {}  # TimeStats equivalent (data/gbdt/TimeStats.java)
-        if train is None:
-            train, test = GBDTIngest(p, self.fs).load()
-        ts["load"] = time.time() - t0
-        K = self.K
-
-        dd = self._prep_device_inputs(train, test)
-        bins, bins_t = dd.bins, dd.bins_t
-        aux_bins, y_t, w_t = dd.aux_bins, dd.y_t, dd.w_t
-        y, weight, real_mask = dd.y, dd.weight, dd.real_mask
-        ts["preprocess"] = time.time() - t0 - ts["load"]
-        log.info("load+preprocess %.1fs", time.time() - t0)
-
-        spec = self._grow_spec(dd.F_prog, dd.B)
-        M = spec.max_nodes
-        F, F_prog, B = dd.F, dd.F_prog, dd.B
-        grow = make_grow_tree(spec, mesh=self.mesh if dd.D > 1 else None)
-
-        base_np = self._base_score(train, K)
-        model = GBDTModel(
-            base_prediction=float(np.mean(base_np)),
-            num_tree_in_group=K,
-            obj_name=self.loss.name,
-        )
-        model, start_round = self._load_resume_model(model, K)
-        scores, scores_t = self._init_device_scores(model, dd, base_np)
-
-        # tree buffers for the whole run, written on device, fetched once
-        T = p.round_num * K
+        T = p.round_num * self.K
         bufs = {
             "feat": jnp.full((T, M), -1, jnp.int32),
             "slot": jnp.zeros((T, M), jnp.int32),
@@ -496,11 +466,18 @@ class GBDTTrainer:
         }
         loss_buf = jnp.zeros((p.round_num,), jnp.float32)
         tloss_buf = jnp.zeros((p.round_num,), jnp.float32)
+        return bufs, loss_buf, tloss_buf
 
+    def _make_round_step(self, dd: "_DevInputs", grow, has_test: bool):
+        """Build the jitted per-round program: grads -> K tree growths ->
+        score/loss updates (reference: GBDTOptimizer.doBoost:482 +
+        predictAndCalcLossGrad:513 as ONE device program per round)."""
+        p = self.params
+        K = self.K
+        F, F_prog = dd.F, dd.F_prog
         loss_fn = self.loss
         inst_rate = p.instance_sample_rate
         feat_rate = p.feature_sample_rate
-        has_test = test is not None
         # LAD leaf refinement on device: the approximate quantile mode
         # (reference: TreeRefiner.java GK-sketch path, lad_refine_appr=true
         # default) as a rank-grid weighted median — exact when the grid
@@ -513,11 +490,6 @@ class GBDTTrainer:
                 "engine uses the approximate rank-grid refine instead "
                 "(pass engine='host' or leave engine='auto' for precise)"
             )
-        # big arrays ride as explicit args (closure capture would bake them
-        # into the program as constants); test arrays fold into `data`
-        data = (bins_t, y, weight, real_mask) + (
-            (aux_bins[0], y_t, w_t) if has_test else ()
-        )
 
         def round_step(carry, rnd, key, data):
             bins_t, y, weight, real_mask = data[:4]
@@ -582,17 +554,25 @@ class GBDTTrainer:
                 )
             return (scores, scores_t, bufs, loss_buf, tloss_buf)
 
-        jit_round = jax.jit(round_step, donate_argnums=(0,))
+        return jax.jit(round_step, donate_argnums=(0,))
+
+    def _run_rounds(
+        self, jit_round, carry, data, dd, model, feature_names,
+        start_round: int, has_test: bool, t0: float, ts: dict,
+    ):
+        """Enqueue the round programs with lagged sync + periodic dumps.
+
+        Lagged sync: materializing a loss through this machine's device
+        tunnel costs ~115 ms D2H, and fetching the CURRENT round's value
+        stalls the enqueue pipeline for exactly that long every sync. At
+        each sync point we enqueue a tiny on-device slice of the loss and
+        materialize it one sync window LATER — by then it completed long
+        ago, so the float() costs one RTT of host time with zero device
+        idle (the queue stays ~2 windows deep; watch mode keeps the
+        synchronous path since its metric evals fetch eagerly anyway)."""
+        p = self.params
+        K = self.K
         root_key = jax.random.PRNGKey(20170425)
-
-        if p.just_evaluate:
-            return self._finalize_device(
-                model, bins, scores, y, weight, scores_t, y_t, w_t,
-                bufs, loss_buf, tloss_buf, start_round, train.feature_names, t0,
-                trained_rounds=start_round,
-            )
-
-        carry = (scores, scores_t, bufs, loss_buf, tloss_buf)
         sync_every = max(1, (p.round_num - start_round) // 20)
         watch_eval = (
             EvalSet(p.eval_metric, K=max(K, 2))
@@ -604,14 +584,6 @@ class GBDTTrainer:
         if profile_dir:
             jax.profiler.start_trace(profile_dir)
         t_train0 = time.time()
-        # lagged sync: materializing a loss through this machine's device
-        # tunnel costs ~115 ms D2H, and fetching the CURRENT round's value
-        # stalls the enqueue pipeline for exactly that long every sync. At
-        # each sync point we enqueue a tiny on-device slice of the loss and
-        # materialize it one sync window LATER — by then it completed long
-        # ago, so the float() costs one RTT of host time with zero device
-        # idle (the queue stays ~2 windows deep; watch mode keeps the
-        # synchronous path since its metric evals fetch eagerly anyway)
         pending: Optional[
             Tuple[int, jnp.ndarray, Optional[jnp.ndarray], float]
         ] = None
@@ -634,7 +606,7 @@ class GBDTTrainer:
                     self._sync_report(rnd, carry, dd, watch_eval, t0)
             if p.model.dump_freq > 0 and (rnd + 1) % p.model.dump_freq == 0:
                 self._append_trees_from_bufs(
-                    model, carry[2], bins, train.feature_names,
+                    model, carry[2], dd.bins, feature_names,
                     len(model.trees), (rnd + 1) * K,
                 )
                 self._dump_model(model)
@@ -652,6 +624,58 @@ class GBDTTrainer:
             r1, s1 = self.sync_log[-1]
             if r1 > r0:
                 ts["trees_per_sec_steady"] = (r1 - r0) * K / max(s1 - s0, 1e-9)
+        return carry
+
+    def _train_device(
+        self, train: Optional[GBDTData], test: Optional[GBDTData]
+    ) -> GBDTResult:
+        p = self.params
+        t0 = time.time()
+        ts = self.time_stats = {}  # TimeStats equivalent (data/gbdt/TimeStats.java)
+        if train is None:
+            train, test = GBDTIngest(p, self.fs).load()
+        ts["load"] = time.time() - t0
+        K = self.K
+
+        dd = self._prep_device_inputs(train, test)
+        bins = dd.bins
+        y, weight, y_t, w_t = dd.y, dd.weight, dd.y_t, dd.w_t
+        ts["preprocess"] = time.time() - t0 - ts["load"]
+        log.info("load+preprocess %.1fs", time.time() - t0)
+
+        spec = self._grow_spec(dd.F_prog, dd.B)
+        grow = make_grow_tree(spec, mesh=self.mesh if dd.D > 1 else None)
+
+        base_np = self._base_score(train, K)
+        model = GBDTModel(
+            base_prediction=float(np.mean(base_np)),
+            num_tree_in_group=K,
+            obj_name=self.loss.name,
+        )
+        model, start_round = self._load_resume_model(model, K)
+        scores, scores_t = self._init_device_scores(model, dd, base_np)
+        bufs, loss_buf, tloss_buf = self._make_tree_bufs(spec.max_nodes)
+
+        has_test = test is not None
+        # big arrays ride as explicit args (closure capture would bake them
+        # into the program as constants); test arrays fold into `data`
+        data = (dd.bins_t, y, weight, dd.real_mask) + (
+            (dd.aux_bins[0], y_t, w_t) if has_test else ()
+        )
+        jit_round = self._make_round_step(dd, grow, has_test)
+
+        if p.just_evaluate:
+            return self._finalize_device(
+                model, bins, scores, y, weight, scores_t, y_t, w_t,
+                bufs, loss_buf, tloss_buf, start_round, train.feature_names, t0,
+                trained_rounds=start_round,
+            )
+
+        carry = (scores, scores_t, bufs, loss_buf, tloss_buf)
+        carry = self._run_rounds(
+            jit_round, carry, data, dd, model, train.feature_names,
+            start_round, has_test, t0, ts,
+        )
         scores, scores_t, bufs, loss_buf, tloss_buf = carry
         t_fin = time.time()
         out = self._finalize_device(
