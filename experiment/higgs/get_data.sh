@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# Download HIGGS and produce higgs.train / higgs.test in this directory
+# (reference surface: experiment/higgs/get_data.sh). Requires network.
+set -euo pipefail
+cd "$(dirname "${BASH_SOURCE[0]}")"
+
+if [ ! -f HIGGS.csv ]; then
+  if [ ! -f HIGGS.csv.gz ]; then
+    echo "downloading HIGGS.csv.gz (2.6 GB)..."
+    wget https://archive.ics.uci.edu/ml/machine-learning-databases/00280/HIGGS.csv.gz
+  fi
+  gunzip HIGGS.csv.gz
+fi
+
+if [ ! -f higgs.train ] || [ ! -f higgs.test ]; then
+  python3 higgs2ytklearn.py HIGGS.csv
+else
+  echo "higgs.train and higgs.test already exist"
+fi

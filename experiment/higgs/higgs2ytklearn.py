@@ -1,0 +1,39 @@
+"""HIGGS.csv -> ytklearn text format (weight###label###f0:v,f1:v,...).
+
+Python-3 rebuild of the reference converter
+(reference experiment/higgs/higgs2ytklearn.py): first 10.5M rows become
+higgs.train, the rest (500k) higgs.test; feature names are the column
+indices, zero-valued features kept (dense physics features).
+"""
+
+import os
+import sys
+
+INPUT = sys.argv[1] if len(sys.argv) > 1 else "HIGGS.csv"
+NUM_TRAIN = int(os.environ.get("HIGGS_NUM_TRAIN", 10_500_000))
+
+
+def write_line(tokens, out):
+    label = int(float(tokens[0]))
+    feats = ",".join(
+        f"{i - 1}:{float(tokens[i]):.7g}" for i in range(1, len(tokens))
+    )
+    out.write(f"1###{label}###{feats}\n")
+
+
+def main():
+    n = 0
+    with open(INPUT) as f, open("higgs.train", "w") as tr, open(
+        "higgs.test", "w"
+    ) as te:
+        for line in f:
+            tokens = line.rstrip("\n").split(",")
+            write_line(tokens, tr if n < NUM_TRAIN else te)
+            n += 1
+            if n % 1_000_000 == 0:
+                print(f"{n} rows", file=sys.stderr)
+    print(f"done: {min(n, NUM_TRAIN)} train / {max(n - NUM_TRAIN, 0)} test")
+
+
+if __name__ == "__main__":
+    main()

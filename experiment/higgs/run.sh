@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Train the Higgs acceptance config on the TPU and batch-predict the test
+# set (reference surface: experiment/higgs/local_optimizer.sh + predict.sh).
+# Run from the repo root:  bash experiment/higgs/run.sh
+set -euo pipefail
+cd "$(dirname "${BASH_SOURCE[0]}")/../.."
+
+bin/tpu_optimizer.sh gbdt experiment/higgs/local_gbdt.conf "$@"
+
+python -m ytklearn_tpu.cli predict experiment/higgs/local_gbdt.conf gbdt \
+  experiment/higgs/higgs.test --eval-metric auc \
+  --save-mode label_and_predict
