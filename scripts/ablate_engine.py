@@ -1,14 +1,23 @@
 """Cost-decomposition ablations for the device GBDT engine at Higgs scale.
 
 Generates data ON DEVICE (no tunnel transfer), trains a few trees per
-config, reports the steady trees/s from trainer.time_stats.
+config, reports the steady trees/s from trainer.time_stats — and, since
+r6, the engine's per-wave histogram log: every histogram pass records
+[rows_scanned, rows_needed, splits, width], so the record SHOWS whether
+late-tree waves cost O(wave rows) (partitioned budgets engaged) or O(n)
+(full scans all the way down).
 
 Usage: python scripts/ablate_engine.py [n_rows] [config ...]
-  configs: b256 (default), b64 (4x fewer hist FLOPs), notest, wave32
+  configs: b256 (default), b64 (4x fewer hist FLOPs), notest, wave32,
+           part / nopart (leaf-partitioned phases on/off A/B),
+           fused / nofused (fused gather kernel vs XLA gather, TPU)
+Env: ABLATE_TREES (default 10), ABLATE_RECORD=path to also write the
+wave-log ablation artifact as JSON (e.g. ABLATION_r06.json).
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import sys
@@ -19,6 +28,35 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 logging.basicConfig(level=logging.INFO, stream=sys.stdout)
+
+_AB_VARS = ("YTK_PARTITION", "YTK_NO_PARTITION", "YTK_FUSED")
+_ENV_OVERRIDES = {
+    # config name -> env var settings applied for that run
+    "part": {},
+    "nopart": {"YTK_NO_PARTITION": "1"},
+    "fused": {"YTK_FUSED": "1"},
+    "nofused": {"YTK_FUSED": "0"},
+}
+
+
+def _apply_env(cfg: str):
+    # every config starts from defaults: a previous config's A/B override
+    # must never leak into (and mislabel) the next run's record
+    for k in _AB_VARS:
+        os.environ.pop(k, None)
+    for k, v in _ENV_OVERRIDES.get(cfg, {}).items():
+        os.environ[k] = v
+
+
+def wave_table(wave_log: np.ndarray, tree: int = -1):
+    """[(rows_scanned, rows_needed, splits, width)] for one tree — the
+    O(wave rows) evidence table."""
+    wl = wave_log[tree]
+    used = wl[:, 3] > 0
+    return [
+        [int(r), int(need), int(k), int(w)]
+        for r, need, k, w in wl[used].tolist()
+    ]
 
 
 def main() -> None:
@@ -31,6 +69,8 @@ def main() -> None:
 
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 10_500_000
     configs = sys.argv[2:] or ["b256"]
+    n_trees = int(os.environ.get("ABLATE_TREES", 10))
+    record_path = os.environ.get("ABLATE_RECORD")
     F = 28
 
     key = jax.random.PRNGKey(0)
@@ -49,11 +89,13 @@ def main() -> None:
         feature_names=[f"f{i}" for i in range(F)],
     )
 
+    record = {"n_rows": n, "configs": {}}
     for cfg in configs:
+        _apply_env(cfg)
         max_cnt = 63 if cfg == "b64" else 255
         wave = {"wave32": 32, "wave42": 42, "wave64": 64}.get(cfg, 16)
         params = GBDTParams(
-            round_num=10,
+            round_num=n_trees,
             max_depth=60,
             max_leaf_cnt=255,
             tree_grow_policy="loss",
@@ -67,11 +109,43 @@ def main() -> None:
         t0 = time.time()
         tr = GBDTTrainer(params, engine="device", wave=wave)
         tr.train(train=train)
+        stats = {k: round(v, 1) for k, v in tr.time_stats.items()
+                 if isinstance(v, float)}
         print(
             f"CONFIG {cfg}: steady={tr.time_stats.get('trees_per_sec_steady', 0):.3f}"
-            f" trees/s  stats={ {k: round(v,1) for k,v in tr.time_stats.items()} }",
+            f" trees/s  stats={stats}",
             flush=True,
         )
+        entry = {
+            "steady_trees_per_sec": tr.time_stats.get("trees_per_sec_steady", 0.0),
+            "time_stats": {
+                k: (round(v, 2) if isinstance(v, float) else v)
+                for k, v in tr.time_stats.items()
+            },
+        }
+        if getattr(tr, "wave_log", None) is not None:
+            # last tree: the representative late-boosting shape; the first
+            # tree shows the identical pattern one round earlier
+            entry["last_tree_waves"] = wave_table(tr.wave_log, tree=-1)
+            entry["wave_columns"] = [
+                "rows_scanned", "rows_needed", "splits", "hist_width"
+            ]
+            wl = tr.wave_log
+            used = wl[..., 3] > 0
+            entry["hist_rows_scanned_total"] = float((wl[..., 0] * used).sum())
+            entry["hist_rows_needed_total"] = float((wl[..., 1] * used).sum())
+            # scan/need ratio: 1.0 = perfectly leaf-partitioned histogram
+            # cost; n/need >> 1 on a full-scan config's late waves
+            need = max(entry["hist_rows_needed_total"], 1.0)
+            entry["scan_over_need"] = round(
+                entry["hist_rows_scanned_total"] / need, 2
+            )
+        record["configs"][cfg] = entry
+
+    if record_path:
+        with open(record_path, "w") as f:
+            json.dump(record, f, indent=1)
+        print(f"ablation record written: {record_path}", flush=True)
 
 
 if __name__ == "__main__":

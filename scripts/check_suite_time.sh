@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# Full-suite wall-clock guard: the whole test suite (slow marks included)
+# must finish under the budget, with the slowest tests named. r5's lesson:
+# a chunking heuristic regression quietly took two FFM tests from seconds
+# to 51 + 27 minutes — this guard turns that into a loud failure.
+#
+# Usage: scripts/check_suite_time.sh [budget_seconds]   (default 2400 = 40 min)
+set -o pipefail
+BUDGET=${1:-2400}
+cd "$(dirname "$0")/.."
+start=$(date +%s)
+timeout -k 10 "$BUDGET" env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+  --durations=15 --continue-on-collection-errors \
+  -p no:cacheprovider -p no:xdist -p no:randomly
+rc=$?
+elapsed=$(( $(date +%s) - start ))
+echo "suite wall time: ${elapsed}s (budget ${BUDGET}s)"
+if [ $rc -eq 124 ] || [ $rc -eq 137 ]; then
+  echo "FAIL: suite exceeded the ${BUDGET}s wall-clock budget" >&2
+  exit 1
+fi
+exit $rc

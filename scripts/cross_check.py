@@ -11,12 +11,14 @@ Run on a machine with a TPU chip:
 
     python scripts/cross_check.py
 
-It grows the tree three ways — TPU Pallas full-scan, TPU Pallas
-leaf-partitioned, CPU 8-device sharded dense — asserts equality, and
-records the tree to tests/data/crosscheck_tree.json. The committed
-golden file lets the CPU test suite (tests/test_crosscheck.py) re-derive
-the sharded tree and compare against what the TPU Pallas path produced,
-without TPU hardware in the loop.
+It grows the tree four ways — TPU Pallas full-scan, TPU Pallas
+leaf-partitioned (XLA gather), TPU Pallas FUSED-partitioned (the r6
+default: compact+gather+histogram in one kernel), CPU 8-device sharded
+dense — asserts equality, and records the tree to
+tests/data/crosscheck_tree.json. The committed golden file lets the CPU
+test suite (tests/test_crosscheck.py) re-derive the sharded tree AND the
+fused-partitioned tree (Pallas interpreter) and compare against what the
+TPU Pallas path produced, without TPU hardware in the loop.
 """
 
 from __future__ import annotations
@@ -49,15 +51,16 @@ def make_case():
     return bins, g, h, n, F, B
 
 
-def spec_for(F, B, force_dense, partition):
+def spec_for(F, B, force_dense, partition, fused=False):
     from ytklearn_tpu.gbdt.engine import GrowSpec
 
     return GrowSpec(
         F=F, B=B, max_nodes=31, wave=4, policy="loss", max_depth=20,
         max_leaves=16, lr=0.1, l1=0.0, l2=1.0, min_h=1.0, max_abs=0.0,
         min_split_loss=0.0, min_split_samples=0.0, hist_mode="int8",
-        force_dense=force_dense, partition=partition,
+        force_dense=force_dense, partition=partition, fused=fused,
         bm=4096,  # small blocks so the 32k-row case tiles on the TPU path
+        bm_g=1024, fused_max_rows=1 << 18,
     )
 
 
@@ -72,7 +75,12 @@ def tree_sig(tr) -> dict:
     }
 
 
-def grow_single(bins, g, h, force_dense, partition, devices=None, B=None):
+def grow_single(
+    bins, g, h, force_dense, partition, devices=None, B=None, fused=False,
+    fused_interpret=False,
+):
+    import dataclasses
+
     import jax
     import jax.numpy as jnp
 
@@ -85,7 +93,9 @@ def grow_single(bins, g, h, force_dense, partition, devices=None, B=None):
         from jax.sharding import Mesh
 
         mesh = Mesh(np.asarray(devices), ("data",))
-    spec = spec_for(F, B, force_dense, partition)
+    spec = spec_for(F, B, force_dense, partition, fused=fused)
+    if fused_interpret:
+        spec = dataclasses.replace(spec, fused=True, fused_interpret=True)
     grow = make_grow_tree(spec, mesh=mesh)
     bins_t = np.ascontiguousarray(bins.T)
     args = (
@@ -105,7 +115,7 @@ def grow_single(bins, g, h, force_dense, partition, devices=None, B=None):
             jax.device_put(args[3], NamedSharding(mesh, P("data"))),
             jax.device_put(args[4], NamedSharding(mesh, P("data"))),
         )
-    tr, pos, _ = jax.jit(lambda *a: grow(*a))(*args)
+    tr, pos, _, _wlog = jax.jit(lambda *a: grow(*a))(*args)
     return tree_sig(tr)
 
 
@@ -124,6 +134,11 @@ def main():
 
     sig_pallas = grow_single(bins, g, h, force_dense=False, partition=False, B=B)
     sig_pallas_part = grow_single(bins, g, h, force_dense=False, partition=True, B=B)
+    # the r6 default TPU path: partitioned budgets through the FUSED
+    # compact+gather+histogram kernel
+    sig_pallas_fused = grow_single(
+        bins, g, h, force_dense=False, partition=True, fused=True, B=B
+    )
 
     # CPU 8-device sharded dense in-process (cpu backend coexists with tpu)
     cpus = jax.devices("cpu")
@@ -135,7 +150,7 @@ def main():
         bins, g, h, force_dense=True, partition=False, devices=cpus[:8], B=B
     )
 
-    ok = sig_pallas == sig_pallas_part == sig_sharded
+    ok = sig_pallas == sig_pallas_part == sig_pallas_fused == sig_sharded
     os.makedirs(os.path.dirname(golden_path), exist_ok=True)
     if ok:
         with open(golden_path, "w") as f:
@@ -145,6 +160,7 @@ def main():
         "ok": ok,
         "n_nodes": sig_pallas["n_nodes"],
         "pallas_eq_partitioned": sig_pallas == sig_pallas_part,
+        "pallas_eq_fused_partitioned": sig_pallas == sig_pallas_fused,
         "pallas_eq_sharded_dense": sig_pallas == sig_sharded,
     }
     print(json.dumps(out))

@@ -21,7 +21,12 @@ import jax  # noqa: E402
 # sitecustomize may have imported jax already (TPU plugin registration), in
 # which case jax.config captured the env at that import — override explicitly.
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # older jax: only the XLA_FLAGS host-platform-device-count path exists
+    # (set above before any jax import could have captured it)
+    pass
 jax.config.update("jax_enable_x64", True)
 
 import pytest  # noqa: E402

@@ -3,10 +3,10 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
 from ytklearn_tpu.parallel import DATA_AXIS, collectives as coll, make_mesh
+from ytklearn_tpu.parallel.mesh import shard_map_compat as shard_map
 
 
 def test_psum_and_scatter_and_gather(mesh8):

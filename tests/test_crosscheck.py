@@ -46,3 +46,22 @@ def test_sharded_dense_matches_recorded_tpu_pallas_tree(mesh8):
     )
     assert sig_part["feat"] == golden["feat"]
     assert sig_part["slot"] == golden["slot"]
+
+
+def test_fused_partitioned_matches_recorded_tpu_pallas_tree():
+    """The FUSED compact+gather+histogram budget path (the r6 TPU
+    default), run through the Pallas interpreter on one CPU device, must
+    grow the same tree the TPU recorded — pinning the fused kernel's
+    semantics against real-chip output without TPU hardware."""
+    with open(GOLDEN) as f:
+        golden = json.load(f)
+    bins, g, h, n, F, B = make_case()
+    sig = grow_single(
+        bins, g, h, force_dense=True, partition=True, fused_interpret=True, B=B
+    )
+    assert sig["n_nodes"] == golden["n_nodes"]
+    assert sig["feat"] == golden["feat"]
+    assert sig["slot"] == golden["slot"]
+    assert sig["left"] == golden["left"]
+    assert sig["right"] == golden["right"]
+    np.testing.assert_allclose(sig["leaf"], golden["leaf"], atol=2e-6)

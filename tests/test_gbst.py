@@ -1,5 +1,6 @@
 """GBST family (gbmlr/gbsdt/gbhmlr/gbhsdt) boosting tests on demo data."""
 
+import os
 import numpy as np
 import pytest
 
@@ -10,6 +11,11 @@ from ytklearn_tpu.io.fs import LocalFileSystem
 from ytklearn_tpu.models.gbst import GBSTModel, heap_leaf_probs
 
 REF = "/root/reference"
+
+needs_ref = pytest.mark.skipif(
+    not os.path.exists(REF),
+    reason="/root/reference demo data not present",
+)
 
 
 def _params(variant, tmp_path, **over):
@@ -45,6 +51,7 @@ def test_heap_leaf_probs_is_distribution():
     )
 
 
+@needs_ref
 @pytest.mark.parametrize("variant", ["gbmlr", "gbsdt", "gbhmlr", "gbhsdt"])
 def test_variant_trains_one_tree(variant, tmp_path, mesh8):
     p = _params(variant, tmp_path, tree_num=1)
@@ -56,6 +63,7 @@ def test_variant_trains_one_tree(variant, tmp_path, mesh8):
         assert res.train_metrics["auc"] > 0.99
 
 
+@needs_ref
 def test_gbmlr_boosting_improves_and_resumes(tmp_path, mesh8):
     p = _params(
         "gbmlr", tmp_path, tree_num=3, learning_rate=0.5,
@@ -87,6 +95,7 @@ def test_gbmlr_boosting_improves_and_resumes(tmp_path, mesh8):
     assert res2.train_loss <= res.train_loss * 1.05 + 1e-6
 
 
+@needs_ref
 def test_gbsdt_tree_roundtrip(tmp_path):
     p = _params("gbsdt", tmp_path, tree_num=1)
     res = GBSTTrainer(p, "gbsdt").train()
@@ -105,6 +114,7 @@ def test_gbsdt_tree_roundtrip(tmp_path):
     assert np.any(w[4:] != 0)  # gates loaded
 
 
+@needs_ref
 def test_random_forest_type(tmp_path):
     p = _params("gbmlr", tmp_path, tree_num=2, type="random_forest")
     assert p.gbst_type == "random_forest"

@@ -1,10 +1,13 @@
-"""Real-Higgs acceptance kit (experiment/higgs): converter + config.
+"""Real-Higgs acceptance kit (experiment/higgs): converter + config +
+the bench's real-data switch.
 
 The training path itself is covered by the engine/demo tests; here the
 kit's pieces are checked so the documented procedure (README.md) works
 the day network access exists: the converter emits the reference text
-format and the UNCHANGED reference config parses into trainer params
-(reference: experiment/higgs/higgs2ytklearn.py + local_gbdt.conf).
+format, the UNCHANGED reference config parses into trainer params
+(reference: experiment/higgs/higgs2ytklearn.py + local_gbdt.conf), and
+bench.py swaps to the real data + reference acceptance band when
+higgs.train exists (YTK_HIGGS_DIR or experiment/higgs/).
 """
 
 import os
@@ -14,6 +17,7 @@ import sys
 import numpy as np
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
 
 
 def test_higgs_converter(tmp_path):
@@ -39,6 +43,59 @@ def test_higgs_converter(tmp_path):
     assert w == "1" and y in ("0", "1")
     kv = feats.split(",")
     assert len(kv) == 28 and kv[0].startswith("0:") and kv[27].startswith("27:")
+
+
+def _write_tiny_higgs(d, n_train=40, n_test=10, F=28, seed=5):
+    rng = np.random.RandomState(seed)
+
+    def write(path, n):
+        with open(path, "w") as f:
+            for _ in range(n):
+                y = rng.randint(0, 2)
+                feats = ",".join(
+                    f"{j}:{v:.5g}" for j, v in enumerate(rng.randn(F))
+                )
+                f.write(f"1###{y}###{feats}\n")
+
+    write(os.path.join(d, "higgs.train"), n_train)
+    write(os.path.join(d, "higgs.test"), n_test)
+
+
+def test_bench_switches_to_real_higgs(tmp_path, monkeypatch):
+    """bench.resolve_gbdt_data must pick up higgs.train/higgs.test from
+    YTK_HIGGS_DIR (real rows, source='higgs'); without them it stays on
+    the no-network synthetic default."""
+    import bench
+
+    monkeypatch.setenv("YTK_HIGGS_DIR", str(tmp_path))
+    assert not bench.has_real_higgs()
+    train, test, source = bench.resolve_gbdt_data(256, 64)
+    assert source == "synthetic"
+    assert train.X.shape == (256, 28)
+
+    _write_tiny_higgs(str(tmp_path))
+    assert bench.has_real_higgs()
+    train, test, source = bench.resolve_gbdt_data(256, 64)
+    assert source == "higgs"
+    assert train.n_real == 40 and train.X.shape[1] == 28
+    assert test is not None and test.n_real == 10
+
+
+def test_bench_band_selection():
+    """Real data asserts the reference acceptance band; synthetic keeps
+    the pinned drift band; any quality knob disables both."""
+    import bench
+
+    # inside the reference band (one band-width slack each side)
+    assert bench.quality_band("higgs", 0.8458, 0.4826, False) == "ok"
+    assert "outside reference band" in bench.quality_band(
+        "higgs", 0.80, 0.55, False
+    )
+    # synthetic band (r4-pinned)
+    assert bench.quality_band("synthetic", 0.9489, 0.3118, False) == "ok"
+    assert "outside" in bench.quality_band("synthetic", 0.93, 0.3118, False)
+    # knob set -> no band applies
+    assert bench.quality_band("higgs", 0.5, 0.9, True) is None
 
 
 def test_higgs_conf_parses():

@@ -9,6 +9,9 @@ from ytklearn_tpu.config import hocon
 from ytklearn_tpu.config.params import CommonParams, GBDTParams
 
 REF_CONF = "/root/reference/config/model"
+needs_ref = pytest.mark.skipif(
+    not os.path.exists(REF_CONF), reason="reference configs not present"
+)
 
 
 def test_basic_scalars():
@@ -63,6 +66,7 @@ def test_set_get_path():
     assert hocon.get_path(cfg, "nope.x", "dflt") == "dflt"
 
 
+@needs_ref
 @pytest.mark.parametrize(
     "name",
     [os.path.basename(p) for p in sorted(glob.glob(f"{REF_CONF}/*.conf"))],
@@ -74,6 +78,7 @@ def test_parses_all_reference_configs(name):
     assert hocon.get_path(cfg, "data.delim.x_delim") == "###"
 
 
+@needs_ref
 def test_common_params_linear():
     cfg = hocon.load(f"{REF_CONF}/linear.conf")
     hocon.set_path(cfg, "data.train.data_path", "/tmp/x")
@@ -87,6 +92,7 @@ def test_common_params_linear():
     assert p.data.unassigned_mode == "lines_avg"
 
 
+@needs_ref
 def test_common_params_fm():
     cfg = hocon.load(f"{REF_CONF}/fm.conf")
     hocon.set_path(cfg, "data.train.data_path", "/tmp/x")
@@ -98,6 +104,7 @@ def test_common_params_fm():
     assert p.bias_need_latent_factor is False
 
 
+@needs_ref
 def test_common_params_ffm_field_delim():
     cfg = hocon.load(f"{REF_CONF}/ffm.conf")
     hocon.set_path(cfg, "data.train.data_path", "/tmp/x")
@@ -107,6 +114,7 @@ def test_common_params_ffm_field_delim():
     assert p.k == [1, 4]
 
 
+@needs_ref
 def test_gbdt_params():
     cfg = hocon.load(f"{REF_CONF}/gbdt.conf")
     hocon.set_path(cfg, "data.train.data_path", "/tmp/x")
@@ -126,6 +134,7 @@ def test_gbdt_params():
     assert p.num_tree_in_group == 1
 
 
+@needs_ref
 def test_gbst_params():
     cfg = hocon.load(f"{REF_CONF}/gbmlr.conf")
     hocon.set_path(cfg, "data.train.data_path", "/tmp/x")

@@ -11,6 +11,12 @@ from ytklearn_tpu.io.feature_hash import FeatureHash, murmur3_x64_128
 from ytklearn_tpu.io.reader import DataIngest, TransformNode, parse_line
 
 REF = "/root/reference"
+
+needs_ref = pytest.mark.skipif(
+    not os.path.exists("/root/reference"),
+    reason="/root/reference demo data not present",
+)
+
 AGARICUS_TRAIN = f"{REF}/demo/data/ytklearn/agaricus.train.ytklearn"
 AGARICUS_TEST = f"{REF}/demo/data/ytklearn/agaricus.test.ytklearn"
 LINEAR_CONF = f"{REF}/demo/linear/binary_classification/linear.conf"
@@ -78,6 +84,7 @@ def _linear_params(tmp_path):
     return CommonParams.from_config(cfg)
 
 
+@needs_ref
 def test_agaricus_ingest(tmp_path):
     p = _linear_params(tmp_path)
     ing = DataIngest(p)
@@ -102,6 +109,7 @@ def test_agaricus_ingest(tmp_path):
     assert padded.weight[tr.n_real:].sum() == 0.0
 
 
+@needs_ref
 def test_filter_threshold_and_dict_roundtrip(tmp_path):
     data = tmp_path / "mini.ytk"
     data.write_text(
@@ -133,6 +141,7 @@ def test_filter_threshold_and_dict_roundtrip(tmp_path):
     assert fmap == {"_bias_": 0, "z": 1, "y": 2, "x": 3}
 
 
+@needs_ref
 def test_transform_standardization(tmp_path):
     data = tmp_path / "t.ytk"
     data.write_text(
@@ -163,6 +172,7 @@ def test_transform_standardization(tmp_path):
     assert nodes[res.feature_map["a"]].mean == pytest.approx(2.0)
 
 
+@needs_ref
 def test_y_sampling_weight_correction(tmp_path):
     data = tmp_path / "s.ytk"
     lines = ["1###0###a:1\n"] * 100 + ["1###1###a:1\n"] * 10

@@ -139,3 +139,12 @@ def test_grid_hyper_search_picks_best(tmp_path, mesh8):
     res = HoagTrainer(p, "linear", mesh=mesh8).train()
     # huge l2 shrinks w to junk; grid must pick the small one by test loss
     assert res.best_l2 == pytest.approx(1e-7)
+import os
+
+
+# the reference checkout ships the demo data these tests replay;
+# absent (e.g. a bare CI container) they cannot run at all
+pytestmark = pytest.mark.skipif(
+    not os.path.exists("/root/reference"),
+    reason="/root/reference demo data not present",
+)

@@ -319,3 +319,12 @@ def test_gbst_predictor_parity(tmp_path):
         leaves = pred.predict_leaf(rows[0][0])
         assert len(leaves) == 2
         assert all(0 <= l < int(p.k) for l in leaves)
+import os
+
+
+# the reference checkout ships the demo data these tests replay;
+# absent (e.g. a bare CI container) they cannot run at all
+pytestmark = pytest.mark.skipif(
+    not os.path.exists("/root/reference"),
+    reason="/root/reference demo data not present",
+)

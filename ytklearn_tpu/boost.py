@@ -169,7 +169,10 @@ class GBSTTrainer:
             w0 = model.init_weights(tree_seed=tree)
             batch = (idx, val, z, gmask, y, w_eff)
             row_chunk = model.suggest_row_chunk(
-                int(idx.shape[0]), int(idx.shape[1]) if idx.ndim > 1 else 1
+                int(idx.shape[0]), int(idx.shape[1]) if idx.ndim > 1 else 1,
+                n_shards=(
+                    int(self.mesh.devices.size) if self.mesh is not None else 1
+                ),
             )
             res = minimize_lbfgs(
                 model.pure_loss,

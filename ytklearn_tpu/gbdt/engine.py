@@ -41,10 +41,17 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .hist import hist_wave, hist_wave_q
+from .hist import BMG_DEFAULT, hist_wave, hist_wave_gather, hist_wave_q
 from .route import route_wave
 
 BIG32 = np.int32(2**31 - 1)
+
+
+def wave_log_rows(max_nodes: int) -> int:
+    """Rows of the per-tree wave log grow() returns (one per histogram
+    pass: root + slow-start ramp + growth waves; trainer buffers and
+    ablation scripts size their arrays with this)."""
+    return max_nodes + 8
 
 
 # ---------------------------------------------------------------------------
@@ -177,6 +184,19 @@ class GrowSpec:
     # compile catastrophe on the current toolchain.
     partition: bool = True
     ladder: Tuple[int, ...] = (8, 32)
+    # fused compact+gather+histogram kernel (hist.hist_wave_gather): budget
+    # rungs at or under `fused_max_rows` skip the XLA (R, F) row gather +
+    # transpose entirely — the kernel DMAs each selected row HBM->VMEM and
+    # accumulates in place. Rungs above the cap keep the XLA gather (the
+    # fused kernel's per-row DMA issue loop is O(R) scalar work, so huge
+    # budgets would pay more in descriptors than they save in MACs).
+    # `fused_interpret` runs the fused kernel through the Pallas
+    # interpreter off-TPU — equivalence tests of the REAL kernel logic on
+    # the CPU mesh.
+    fused: bool = True
+    fused_max_rows: int = 1 << 18
+    fused_interpret: bool = False
+    bm_g: int = BMG_DEFAULT
 
     @property
     def depth_cap(self) -> int:
@@ -241,7 +261,12 @@ def make_grow_tree(spec: GrowSpec, mesh=None, axis: str = "data"):
     matrices (e.g. the test set) whose row positions are routed through
     the same splits; their final leaf assignment comes back alongside.
 
-    Returns (TreeArrays, pos_final, aux_pos_final).
+    Returns (TreeArrays, pos_final, aux_pos_final, wave_log) where
+    wave_log (max_nodes+8, 4) f32 records per histogram pass
+    [rows_scanned, rows_needed, splits, hist_width] — the roofline and
+    O(wave rows) ablation record (row 0 = root pass; rows with
+    hist_width 0 are unused slots; row counts are per-shard under a
+    mesh, exact on one device).
 
     With a mesh of >1 devices the SAME growth program runs under
     `shard_map` over row shards — each device feeds its local rows to the
@@ -264,17 +289,21 @@ def make_grow_tree(spec: GrowSpec, mesh=None, axis: str = "data"):
 
     from jax.sharding import PartitionSpec as P
 
+    from ..parallel.mesh import shard_map_compat
+
     def grow_sharded(bins_t, include, g, h, feat_mask, aux=()):
         def f(bins_t, include, g, h, feat_mask, aux):
             return grow(bins_t, include, g, h, feat_mask, aux=aux)
 
-        return jax.shard_map(
+        return shard_map_compat(
             f,
             mesh=mesh,
             in_specs=(
                 P(None, axis), P(axis), P(axis), P(axis), P(axis), P(None, axis),
             ),
-            out_specs=(P(), P(axis), P(axis)),
+            # wave_log is replicated: rows/splits/width are static or come
+            # from the globally-merged frontier stats
+            out_specs=(P(), P(axis), P(axis), P()),
             check_vma=False,
         )(bins_t, include, g, h, feat_mask, tuple(aux))
 
@@ -343,18 +372,25 @@ def _build_grow(spec: GrowSpec, n_shards: int = 1, axis: str = "data"):
         iota_n = jnp.arange(n, dtype=jnp.int32)
 
         # leaf-partition budget ladder (static shapes, ascending): a wave
-        # hists only smaller children, so ceil(n/2) always fits budget 0
+        # hists only smaller children, so ceil(n/2) always fits budget 0.
+        # Each rung carries its implementation: "fused" (compact+gather+
+        # histogram in one Pallas kernel, small budgets) or "xla" (explicit
+        # row gather + the full-scan kernel, the only option above
+        # fused_max_rows where per-row DMA issue would dominate).
         use_part = spec.partition
-        unit = 128 if spec.force_dense else spec.bm
+        can_fuse = spec.fused and (not spec.force_dense or spec.fused_interpret)
+        unit_xla = 128 if spec.force_dense else spec.bm
         if use_part:
-            R_list = []
+            rungs = []  # ascending [(R, impl)]
             for div in spec.ladder:
                 want = -(-n // div)  # ceil(n / div)
+                fuse = can_fuse and want <= spec.fused_max_rows
+                unit = spec.bm_g if fuse else unit_xla
                 R = max(-(-want // unit) * unit, unit)
-                if R < n and R not in R_list:
-                    R_list.append(R)
-            R_list.sort()
-            use_part = bool(R_list)
+                if R < n and R not in [r for r, _ in rungs]:
+                    rungs.append((R, "fused" if fuse else "xla"))
+            rungs.sort()
+            use_part = bool(rungs)
         if use_part:
             # row-major copy for the per-wave row gather (shard-local under
             # shard_map; materialized once per tree, ~n*F bytes at u8)
@@ -423,14 +459,18 @@ def _build_grow(spec: GrowSpec, n_shards: int = 1, axis: str = "data"):
             """Full-scan histogram (root + slow start + big-wave phases)."""
             return hist_finish(hist_partial(bins_k, pos_fit, G_, H_, ids))
 
-        def hist_budget(R: int):
+        def hist_budget(R: int, impl: str = "xla"):
             """Leaf-partitioned histogram at static budget R: compact the
-            rows belonging to the wave's nodes, gather their bins/grads,
-            and run the SAME kernel on R rows instead of n. The phase
-            loop's condition guarantees the wave needs <= R rows. (This is
-            deliberately cond-free: lax.cond around a Mosaic kernel takes
-            >10 min to compile on this toolchain — phase-separated
-            while_loops select the budget instead.)"""
+            rows belonging to the wave's nodes and histogram only those —
+            R rows instead of n. The phase loop's condition guarantees the
+            wave needs <= R rows. (This is deliberately cond-free: lax.cond
+            around a Mosaic kernel takes >10 min to compile on this
+            toolchain — phase-separated while_loops select the budget.)
+
+            impl="fused": the row-index list goes straight into the fused
+            Pallas kernel (per-row DMA gather + in-kernel accumulation) —
+            no (R, F) XLA gather, no transpose. impl="xla": the original
+            explicit gather + full-scan kernel (large budgets)."""
 
             def call(pos_fit, ids):
                 mask = jnp.zeros(pos_fit.shape, bool)
@@ -444,6 +484,15 @@ def _build_grow(spec: GrowSpec, n_shards: int = 1, axis: str = "data"):
                 pg = jnp.where(valid, jnp.take(pos_fit, idx), -1)
                 gg = jnp.take(G_, idx)
                 hg = jnp.take(H_, idx)
+                if impl == "fused":
+                    part = hist_wave_gather(
+                        bins_rows, idx, pg, gg, hg, ids, B,
+                        mode=spec.hist_mode if spec.hist_mode == "int8" else "mxu",
+                        use_bf16=spec.use_bf16, bm_g=spec.bm_g,
+                        force_dense=spec.force_dense and not spec.fused_interpret,
+                        interpret=spec.fused_interpret,
+                    )
+                    return hist_finish(part)
                 bg = jnp.take(bins_rows, idx, axis=0)  # (R, F) u8
                 bt = jnp.transpose(bg).astype(jnp.int32)
                 if not spec.force_dense:
@@ -507,8 +556,27 @@ def _build_grow(spec: GrowSpec, n_shards: int = 1, axis: str = "data"):
         )
         leaves0 = jnp.asarray(1, jnp.int32)
 
+        # wave log: [rows_scanned (static hist cost), rows_needed (exact
+        # smaller-child sum), splits made, hist width N] per wave — the
+        # roofline/ablation record (fetched once per tree, a few KB).
+        # Row 0 is the root histogram pass. ALL row counts are PER-SHARD
+        # (rows_scanned is the local n / local budget R already; the need
+        # columns divide the globally-merged frontier counts by the shard
+        # count) so scanned-vs-needed comparisons and per-chip utilization
+        # stay unit-consistent on a mesh. Exact on one device.
+        MW = wave_log_rows(M)  # waves <= splits + slow-start ramp + root
+        inv_shards = 1.0 / float(max(n_shards, 1))
+        wlog0 = jnp.zeros((MW, 4), jnp.float32)
+        wlog0 = wlog0.at[0].set(
+            jnp.stack([
+                jnp.float32(n), root_ghc[2] * inv_shards,
+                jnp.float32(0.0), jnp.float32(1.0),
+            ])
+        )
+        wcnt0 = jnp.asarray(1, jnp.int32)
+
         def cond(state):
-            tr, fr, pool, pos, aux_pos, leaves = state
+            tr, fr, pool, pos, aux_pos, leaves, wlog, wcnt = state
             return jnp.any(can_split(fr, tr, leaves))
 
         def wave_need(state):
@@ -516,7 +584,7 @@ def _build_grow(spec: GrowSpec, n_shards: int = 1, axis: str = "data"):
             smaller-child counts over the nodes the selection would pick.
             Drives the phase-loop budget transitions (computed from frontier
             stats — C-channel counts match the compaction mask exactly)."""
-            tr, fr, pool, pos, aux_pos, leaves = state
+            tr, fr, pool, pos, aux_pos, leaves, wlog, wcnt = state
             ok = can_split(fr, tr, leaves)
             sel, sel_ok = select(ok, fr, tr, NW)
             order_cum = jnp.cumsum(sel_ok.astype(jnp.int32), dtype=jnp.int32)
@@ -524,11 +592,11 @@ def _build_grow(spec: GrowSpec, n_shards: int = 1, axis: str = "data"):
             small_cnt = jnp.minimum(fr.CL[sel], fr.CR[sel])
             return jnp.sum(jnp.where(sel_ok, small_cnt, 0.0))
 
-        def make_body(nw: int, hist_fn=None):
-            return lambda state: wave_body(state, nw, hist_fn)
+        def make_body(nw: int, hist_fn=None, hist_rows: int = None):
+            return lambda state: wave_body(state, nw, hist_fn, hist_rows)
 
-        def wave_body(state, nw: int, hist_fn=None):
-            tr, fr, pool, pos, aux_pos, leaves = state
+        def wave_body(state, nw: int, hist_fn=None, hist_rows: int = None):
+            tr, fr, pool, pos, aux_pos, leaves, wlog, wcnt = state
             ok = can_split(fr, tr, leaves)
             sel, sel_ok = select(ok, fr, tr, nw)
 
@@ -627,9 +695,23 @@ def _build_grow(spec: GrowSpec, n_shards: int = 1, axis: str = "data"):
                 .at[cids]
                 .set(True, **drop),
             )
-            return (tr, fr, pool, pos, aux_pos, (leaves + k_cnt).astype(jnp.int32))
+            need = jnp.sum(
+                jnp.where(sel_ok, jnp.minimum(CLs, CRs), 0.0)
+            ) * inv_shards
+            rows_f = jnp.float32(n if hist_rows is None else hist_rows)
+            wlog = wlog.at[wcnt].set(
+                jnp.stack([
+                    rows_f, need, k_cnt.astype(jnp.float32), jnp.float32(nw)
+                ]),
+                mode="drop",
+            )
+            return (
+                tr, fr, pool, pos, aux_pos,
+                (leaves + k_cnt).astype(jnp.int32),
+                wlog, (wcnt + 1).astype(jnp.int32),
+            )
 
-        state = (tr, fr, pool, pos, aux_pos, leaves0)
+        state = (tr, fr, pool, pos, aux_pos, leaves0, wlog0, wcnt0)
         # slow start: after k waves at most 2^k nodes are expandable, so the
         # first waves run right-sized (N = 1, 2, 4, ...) — identical split
         # decisions to full-width waves at a fraction of the one-hot matmul
@@ -645,7 +727,7 @@ def _build_grow(spec: GrowSpec, n_shards: int = 1, axis: str = "data"):
             # shrinks, then a full-scan tail for any non-monotone leftovers
             # (need is near-monotone decreasing under gain-ordered
             # selection; the tail keeps pathological orders correct)
-            Rs = sorted(R_list, reverse=True)  # big -> small
+            Rs = sorted(rungs, reverse=True)  # big -> small [(R, impl)]
 
             def mk_cond(lo, hi):
                 # `need` is the GLOBAL wave row count (frontier stats are
@@ -666,20 +748,19 @@ def _build_grow(spec: GrowSpec, n_shards: int = 1, axis: str = "data"):
                 return cond_fn
 
             state = jax.lax.while_loop(
-                mk_cond(Rs[0], None), make_body(NW), state
+                mk_cond(Rs[0][0], None), make_body(NW), state
             )
-            for i, R in enumerate(Rs):
-                nxt = Rs[i + 1] if i + 1 < len(Rs) else None
+            for i, (R, impl) in enumerate(Rs):
+                nxt = Rs[i + 1][0] if i + 1 < len(Rs) else None
                 state = jax.lax.while_loop(
-                    mk_cond(nxt, R), make_body(NW, hist_budget(R)), state
+                    mk_cond(nxt, R),
+                    make_body(NW, hist_budget(R, impl), hist_rows=R),
+                    state,
                 )
-            tr, fr, pool, pos, aux_pos, leaves = jax.lax.while_loop(
-                cond, make_body(NW), state
-            )
+            state = jax.lax.while_loop(cond, make_body(NW), state)
         else:
-            tr, fr, pool, pos, aux_pos, leaves = jax.lax.while_loop(
-                cond, make_body(NW), state
-            )
-        return tr, pos, aux_pos
+            state = jax.lax.while_loop(cond, make_body(NW), state)
+        tr, fr, pool, pos, aux_pos, leaves, wlog, wcnt = state
+        return tr, pos, aux_pos, wlog
 
     return grow

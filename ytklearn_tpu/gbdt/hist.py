@@ -274,6 +274,260 @@ def hist_wave(
     return jnp.transpose(out, (2, 0, 3, 1))
 
 
+# ---------------------------------------------------------------------------
+# Fused compact+gather+histogram kernel (leaf-partitioned waves)
+# ---------------------------------------------------------------------------
+#
+# Late-tree waves touch a few thousand rows out of millions. The XLA
+# formulation (gather (R, F) rows + transpose + full kernel) loses on TPU
+# because real-index gathers run far off the strided path. This kernel
+# fuses the row gather INTO the histogram pass: the wave's compacted
+# row-index list arrives in SMEM tiles, each grid step issues one small
+# DMA per selected row (HBM row-major bins -> VMEM scratch, all in
+# flight before the first wait), and the gathered tile feeds the same
+# one-hot MXU accumulation as the dense kernels — no (R, F) gather, no
+# transpose, no extra HBM round trip. Wave cost becomes O(R) DMA issues
+# + O(R*N*B) MACs instead of O(n*N*B).
+#
+# Layout: the gathered tile is ROW-major (rows on sublanes), so the bin
+# one-hot is built per feature from a lane-column slice and the MXU pass
+# is a plain NN dot PV (3N, bm_g) @ OH (bm_g, bins B) — pos/g/h tiles stay
+# lane-major exactly like the full-scan kernels.
+
+BMG_DEFAULT = 1024  # gathered-tile rows (sublane dim of the NN dot)
+
+
+def _tpu_compiler_params(**kw):
+    """jax renamed TPUCompilerParams -> CompilerParams; the fused kernel
+    traces on CPU too (interpret-mode tests), so resolve at call time."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    return cls(**kw)
+
+
+def _gather_grid_call(
+    rows, idx, pos_g, g_t, h_t, ids2, out_dtype, kernel, B, bm_g, interpret
+):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    R = idx.shape[0]
+    F = rows.shape[1]
+    N = ids2.shape[0]
+    assert R % bm_g == 0, (R, bm_g)
+    return pl.pallas_call(
+        kernel,
+        grid=(R // bm_g,),
+        in_specs=[
+            pl.BlockSpec((bm_g,), lambda t: (t,), memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.ANY),  # rows stay in HBM
+            pl.BlockSpec((1, 1, bm_g), lambda t: (t, 0, 0)),
+            pl.BlockSpec((1, 1, bm_g), lambda t: (t, 0, 0)),
+            pl.BlockSpec((1, 1, bm_g), lambda t: (t, 0, 0)),
+            pl.BlockSpec((N, 1), lambda t: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((F, 3 * N, B), lambda t: (0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((F, 3 * N, B), out_dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bm_g, F), rows.dtype),
+            pltpu.SemaphoreType.DMA,
+        ],
+        compiler_params=_tpu_compiler_params(
+            dimension_semantics=("arbitrary",),
+        ),
+        interpret=interpret,
+    )(idx, rows, pos_g, g_t, h_t, ids2)
+
+
+def _gather_rows_dma(idx_ref, rows_ref, scratch, sem, bm_g: int):
+    """Issue one DMA per selected row (all in flight), then drain. The
+    issue loop is the kernel's dominant cost at large R — which is why
+    the budget ladder only routes small waves here."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    def issue(i, c):
+        iv = idx_ref[i]
+        pltpu.make_async_copy(
+            rows_ref.at[pl.ds(iv, 1), :], scratch.at[pl.ds(i, 1), :], sem
+        ).start()
+        return c
+
+    jax.lax.fori_loop(0, bm_g, issue, 0)
+
+    def drain(i, c):
+        pltpu.make_async_copy(
+            rows_ref.at[pl.ds(0, 1), :], scratch.at[pl.ds(0, 1), :], sem
+        ).wait()
+        return c
+
+    jax.lax.fori_loop(0, bm_g, drain, 0)
+
+
+@partial(
+    jax.jit, static_argnames=("B", "bm_g", "use_bf16", "interpret")
+)
+def _hist_gather_pallas(
+    rows, idx, pos_g, g, h, node_ids, B: int, bm_g: int, use_bf16: bool,
+    interpret: bool,
+):
+    """Fused gather+histogram, f32/bf16 MXU variant.
+
+    rows     (n, F) u8|i32 — ROW-major bin matrix (HBM resident)
+    idx      (R,) i32      — compacted row indices (R % bm_g == 0; slots
+                             past the wave's row count point at row 0 and
+                             are masked by pos_g = -1)
+    pos_g    (R,) i32      — node id per gathered row (-1 = dead slot)
+    g, h     (R,) f32      — gathered weighted grad / hess
+    node_ids (N,) i32      — wave node ids (-2 pads match nothing)
+    Returns (F, 3N, B) f32 partial histograms, rows [g*N | h*N | c*N].
+    """
+    from jax import lax
+
+    R = idx.shape[0]
+    F = rows.shape[1]
+    N = node_ids.shape[0]
+    cdt = jnp.bfloat16 if use_bf16 else jnp.float32
+    prec = None if use_bf16 else jax.lax.Precision.HIGHEST
+    nn = (((1,), (0,)), ((), ()))  # A @ B
+
+    pos3 = pos_g.reshape(R // bm_g, 1, bm_g)
+    g3 = g.reshape(R // bm_g, 1, bm_g)
+    h3 = h.reshape(R // bm_g, 1, bm_g)
+    ids2 = node_ids.reshape(N, 1)
+
+    def kernel(idx_ref, rows_ref, pos_ref, g_ref, h_ref, ids_ref, out_ref,
+               scratch, sem):
+        from jax.experimental import pallas as pl
+
+        t = pl.program_id(0)
+        _gather_rows_dma(idx_ref, rows_ref, scratch, sem, bm_g)
+        p = pos_ref[0, 0, :][None, :]  # (1, bm_g) lanes
+        P = (ids_ref[:, 0:1] == p).astype(cdt)  # (N, bm_g)
+        gv = g_ref[0, 0, :][None, :].astype(cdt)
+        hv = h_ref[0, 0, :][None, :].astype(cdt)
+        PV = jnp.concatenate([P * gv, P * hv, P], axis=0)  # (3N, bm_g)
+        iota_b = jax.lax.broadcasted_iota(jnp.int32, (1, B), 1)
+        for f in range(F):
+            col = scratch[:, f : f + 1].astype(jnp.int32)  # (bm_g, 1)
+            OH = (col == iota_b).astype(cdt)  # (bm_g, B) row-major
+            acc = lax.dot_general(
+                PV, OH, nn, precision=prec,
+                preferred_element_type=jnp.float32,
+            )  # (3N, B)
+
+            @pl.when(t == 0)
+            def _():
+                out_ref[f, :, :] = acc
+
+            @pl.when(t > 0)
+            def _():
+                out_ref[f, :, :] = out_ref[f, :, :] + acc
+
+    return _gather_grid_call(
+        rows, idx, pos3, g3, h3, ids2, jnp.float32, kernel, B, bm_g, interpret
+    )
+
+
+@partial(jax.jit, static_argnames=("B", "bm_g", "interpret"))
+def _hist_gather_pallas_q(
+    rows, idx, pos_g, gq, hq, node_ids, B: int, bm_g: int, interpret: bool
+):
+    """Fused gather+histogram, int8 variant (gq/hq are f32 integers in
+    [-127, 127], caller owns the scales; i32 accumulation is exact and
+    order-independent, so fused-budget trees equal full-scan trees
+    bit-for-bit). Returns (F, 3N, B) int32."""
+    from jax import lax
+
+    R = idx.shape[0]
+    F = rows.shape[1]
+    N = node_ids.shape[0]
+    nn = (((1,), (0,)), ((), ()))
+
+    pos3 = pos_g.reshape(R // bm_g, 1, bm_g)
+    g3 = gq.reshape(R // bm_g, 1, bm_g)
+    h3 = hq.reshape(R // bm_g, 1, bm_g)
+    ids2 = node_ids.reshape(N, 1)
+
+    def kernel(idx_ref, rows_ref, pos_ref, g_ref, h_ref, ids_ref, out_ref,
+               scratch, sem):
+        from jax.experimental import pallas as pl
+
+        t = pl.program_id(0)
+        _gather_rows_dma(idx_ref, rows_ref, scratch, sem, bm_g)
+        p = pos_ref[0, 0, :][None, :]
+        Pb = ids_ref[:, 0:1] == p  # (N, bm_g) bool
+        # int8 multiplies / selects don't legalize in Mosaic — mask in f32
+        # and cast the assembled block (same trick as _hist_pallas_q)
+        P = Pb.astype(jnp.float32)
+        gv = P * g_ref[0, 0, :][None, :]
+        hv = P * h_ref[0, 0, :][None, :]
+        PV = jnp.concatenate([gv, hv, P], axis=0).astype(jnp.int8)  # (3N, bm_g)
+        iota_b = jax.lax.broadcasted_iota(jnp.int32, (1, B), 1)
+        for f in range(F):
+            col = scratch[:, f : f + 1].astype(jnp.int32)
+            OH = (col == iota_b).astype(jnp.int8)  # (bm_g, B)
+            acc = lax.dot_general(
+                PV, OH, nn, preferred_element_type=jnp.int32
+            )  # (3N, B) i32
+
+            @pl.when(t == 0)
+            def _():
+                out_ref[f, :, :] = acc
+
+            @pl.when(t > 0)
+            def _():
+                out_ref[f, :, :] = out_ref[f, :, :] + acc
+
+    return _gather_grid_call(
+        rows, idx, pos3, g3, h3, ids2, jnp.int32, kernel, B, bm_g, interpret
+    )
+
+
+def hist_wave_gather(
+    rows,
+    idx,
+    pos_g,
+    g,
+    h,
+    node_ids,
+    B: int,
+    mode: str = "mxu",
+    use_bf16: bool = True,
+    bm_g: int = BMG_DEFAULT,
+    force_dense: bool = False,
+    interpret: bool = False,
+):
+    """(N, F, B, 3) partial histograms over a compacted row subset.
+
+    The TPU path runs the fused gather+hist kernel; off-TPU (unless
+    `interpret` forces the Pallas interpreter, for tests) the same math
+    runs as an explicit (R, F) gather + dense einsum — bit-identical in
+    int8 mode. Output dtype matches hist_wave (f32) / hist_wave_q (i32).
+    """
+    F = rows.shape[1]
+    N = node_ids.shape[0]
+    on_tpu = jax.default_backend() == "tpu"
+    if (on_tpu and not force_dense) or interpret:
+        if mode == "int8":
+            out = _hist_gather_pallas_q(
+                rows, idx, pos_g, g, h, node_ids, B, bm_g, interpret
+            )
+        else:
+            out = _hist_gather_pallas(
+                rows, idx, pos_g, g, h, node_ids, B, bm_g, use_bf16, interpret
+            )
+    else:
+        bt = jnp.transpose(jnp.take(rows, idx, axis=0)).astype(jnp.int32)
+        if mode == "int8":
+            out = _hist_dense_q(bt, pos_g, g, h, node_ids, B)
+        else:
+            out = _hist_dense(bt, pos_g, g, h, node_ids, B, use_bf16)
+    out = out.reshape(F, 3, N, B)
+    return jnp.transpose(out, (2, 0, 3, 1))
+
+
 def pad_inputs(
     bins: np.ndarray, bm: int = BM_DEFAULT, n_pad: int = None, F_pad: int = None
 ):

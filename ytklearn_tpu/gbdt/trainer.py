@@ -56,7 +56,13 @@ from .binning import (
     build_bins_maybe_device,
 )
 from .data import GBDTData, GBDTIngest
-from .engine import GrowSpec, make_gain_fns, make_grow_tree, split_kernel
+from .engine import (
+    GrowSpec,
+    make_gain_fns,
+    make_grow_tree,
+    split_kernel,
+    wave_log_rows,
+)
 from .hist import BM_DEFAULT, pad_inputs
 from .tree import GBDTModel, Tree
 
@@ -302,6 +308,29 @@ class GBDTTrainer:
         # mesh); mesh>1 runs the SAME Pallas kernels per shard under
         # shard_map (r3 VERDICT #1: no more force_dense on multi-chip)
         force_dense = jax.default_backend() != "tpu"
+        # leaf-partitioned histogram phases: DEFAULT-ON everywhere since r6
+        # (the fused compact+gather+histogram kernel makes late-tree waves
+        # O(wave rows) on TPU too — r5 shipped this opt-in because the XLA
+        # row gather lost money there). YTK_PARTITION=0 or YTK_NO_PARTITION=1
+        # turns it off, so an A/B "off" run can never silently run
+        # partitioned; YTK_PARTITION=1 stays accepted (now a no-op).
+        partition = (
+            os.environ.get("YTK_NO_PARTITION") != "1"
+            and os.environ.get("YTK_PARTITION") != "0"
+        )
+        # budget ladder divisors: the TPU default routes only genuinely
+        # late waves (<= n/64 rows) into partitioned passes, all through
+        # the fused kernel — the XLA-gather rungs at n/8, n/32 measured as
+        # net losers on TPU in r5 and stay off the default there. The CPU
+        # dense path keeps the r5 ladder (gathers are cheap on CPU).
+        # YTK_LADDER / YTK_FUSED / YTK_FUSED_MAX_ROWS override for tuning.
+        ladder_env = os.environ.get("YTK_LADDER")
+        if ladder_env:
+            ladder = tuple(int(x) for x in ladder_env.split(",") if x.strip())
+        else:
+            ladder = (8, 32) if force_dense else (64, 256)
+        fused = os.environ.get("YTK_FUSED", "1") != "0"
+        fused_max_rows = int(os.environ.get("YTK_FUSED_MAX_ROWS", str(1 << 18)))
         return GrowSpec(
             F=F,
             B=B,
@@ -320,17 +349,10 @@ class GBDTTrainer:
             use_bf16=self.use_bf16_hist,
             force_dense=force_dense,
             hist_mode="int8" if self.hist_precision == "int8" else "mxu",
-            # leaf-partitioned hist passes: opt-in on TPU while the phase
-            # thresholds are tuned (YTK_PARTITION=1; correctness is
-            # equivalence-tested either way). On CPU (dense kernels) the
-            # partitioned path is the default. YTK_NO_PARTITION=1 always
-            # wins so an A/B "off" run can never silently run partitioned.
-            partition=(
-                os.environ.get("YTK_NO_PARTITION") != "1"
-                and (
-                    os.environ.get("YTK_PARTITION") == "1" or force_dense
-                )
-            ),
+            partition=partition,
+            ladder=ladder,
+            fused=fused,
+            fused_max_rows=fused_max_rows,
         )
 
     def _prep_device_inputs(self, train: GBDTData, test: Optional[GBDTData]):
@@ -463,6 +485,10 @@ class GBDTTrainer:
             "hess": jnp.zeros((T, M), jnp.float32),
             "cnt": jnp.zeros((T, M), jnp.float32),
             "n_nodes": jnp.zeros((T,), jnp.int32),
+            # per-tree wave log from grow(): [rows_scanned, rows_needed,
+            # splits, hist_width] per histogram pass — the roofline /
+            # O(wave rows) ablation record (~8 KB per tree)
+            "wlog": jnp.zeros((T, wave_log_rows(M), 4), jnp.float32),
         }
         loss_buf = jnp.zeros((p.round_num,), jnp.float32)
         tloss_buf = jnp.zeros((p.round_num,), jnp.float32)
@@ -516,7 +542,9 @@ class GBDTTrainer:
             for grp in range(K):
                 g = (gs[:, grp] if K > 1 else gs) * weight
                 h = (hs[:, grp] if K > 1 else hs) * weight
-                tr, pos, aux_pos = grow(bins_t, include, g, h, fmask, aux=aux_bins)
+                tr, pos, aux_pos, wlog = grow(
+                    bins_t, include, g, h, fmask, aux=aux_bins
+                )
                 if refine_lad:
                     tr = _lad_refine_device(
                         tr, pos, y, scores, weight, real_mask, p.learning_rate
@@ -542,6 +570,7 @@ class GBDTTrainer:
                         arr.astype(bufs[name].dtype)
                     )
                 bufs["n_nodes"] = bufs["n_nodes"].at[t_idx].set(tr.n_nodes)
+                bufs["wlog"] = bufs["wlog"].at[t_idx].set(wlog)
 
             per = jnp.where(weight > 0, loss_fn.loss(scores, y), 0.0)
             loss_buf = loss_buf.at[rnd].set(
@@ -555,6 +584,88 @@ class GBDTTrainer:
             return (scores, scores_t, bufs, loss_buf, tloss_buf)
 
         return jax.jit(round_step, donate_argnums=(0,))
+
+    def _build_round_step(self, dd: "_DevInputs", spec: GrowSpec, has_test: bool):
+        grow = make_grow_tree(spec, mesh=self.mesh if dd.D > 1 else None)
+        return self._make_round_step(dd, grow, has_test)
+
+    def _probe_compile(
+        self, jit_round, carry, data, dd, has_test: bool, spec: GrowSpec,
+        start_round: int,
+    ):
+        """AOT-compile the round program with graceful degradation (TPU
+        only): a Mosaic/XLA failure in the fused or partitioned program
+        downgrades to the XLA-gather partitioned program, then to the
+        full-scan program — a toolchain regression costs throughput, never
+        the run. Returns (callable, effective_spec); the compiled object
+        is reused for every round, so the probe is not a second compile.
+        YTK_PARTITION_STRICT=1 keeps failures loud (equivalence runs)."""
+        if (
+            jax.default_backend() != "tpu"
+            or os.environ.get("YTK_PARTITION_STRICT") == "1"
+        ):
+            return jit_round, spec
+        import dataclasses
+
+        args = (
+            carry,
+            jnp.asarray(start_round),
+            jax.random.fold_in(jax.random.PRNGKey(20170425), start_round),
+            data,
+        )
+        downgrades = []
+        if spec.partition and spec.fused:
+            downgrades.append(({"fused": False}, "XLA-gather partitioned phases"))
+        if spec.partition:
+            downgrades.append(({"partition": False}, "full-scan histograms"))
+        while True:
+            try:
+                return jit_round.lower(*args).compile(), spec
+            except Exception as e:  # noqa: BLE001 — downgrade on any compile failure
+                if not downgrades:
+                    raise
+                change, label = downgrades.pop(0)
+                log.warning(
+                    "device round program failed to compile (%s: %.300s); "
+                    "retrying with %s",
+                    type(e).__name__, e, label,
+                )
+                spec = dataclasses.replace(spec, **change)
+                jit_round = self._build_round_step(dd, spec, has_test)
+
+    def _export_wave_stats(self, ts: dict, dd: "_DevInputs", spec: GrowSpec):
+        """Analytic device-cost totals from the engine's wave log — the
+        inputs to the bench's achieved-vs-peak MXU/HBM accounting and the
+        O(wave rows) ablation record. The model counts the dominant device
+        work only (histogram one-hot matmuls + routing traffic); split
+        enumeration and score updates are O(nodes) / O(n) per ROUND and
+        small beside them."""
+        wl = self.wave_log  # (T, MW, 4)
+        used = wl[..., 3] > 0
+        F, B = dd.F_prog, dd.B
+        bins_bytes = 1 if dd.B <= 256 else 4
+        rows_scanned = float((wl[..., 0] * used).sum())
+        n_trees = float(used.any(axis=-1).sum())
+        ts["hist_passes"] = float(used.sum())
+        ts["hist_rows_scanned"] = rows_scanned
+        ts["hist_rows_needed"] = float((wl[..., 1] * used).sum())
+        # one-hot accumulation: rows x (3 * width) x B MACs per feature
+        ts["hist_macs"] = float(
+            (wl[..., 0] * 3.0 * wl[..., 3] * used).sum()
+        ) * B * F
+        # histogram pass traffic: bins row + pos/g/h per scanned row
+        ts["hist_bytes"] = rows_scanned * (F * bins_bytes + 12)
+        # routing: every wave re-reads each row's bins + pos, writes pos
+        # (root pass routes nothing). Per-DEVICE rows, matching the wave
+        # log's per-shard units and the single-chip peak comparison.
+        rows_per_device = dd.n_score / max(dd.D, 1)
+        route_waves = float(used.sum()) - n_trees
+        ts["route_bytes"] = route_waves * rows_per_device * (F * bins_bytes + 8)
+        ts["partition"] = bool(spec.partition)
+        ts["fused"] = bool(
+            spec.partition and spec.fused
+            and (not spec.force_dense or spec.fused_interpret)
+        )
 
     def _run_rounds(
         self, jit_round, carry, data, dd, model, feature_names,
@@ -644,7 +755,6 @@ class GBDTTrainer:
         log.info("load+preprocess %.1fs", time.time() - t0)
 
         spec = self._grow_spec(dd.F_prog, dd.B)
-        grow = make_grow_tree(spec, mesh=self.mesh if dd.D > 1 else None)
 
         base_np = self._base_score(train, K)
         model = GBDTModel(
@@ -662,7 +772,7 @@ class GBDTTrainer:
         data = (dd.bins_t, y, weight, dd.real_mask) + (
             (dd.aux_bins[0], y_t, w_t) if has_test else ()
         )
-        jit_round = self._make_round_step(dd, grow, has_test)
+        jit_round = self._build_round_step(dd, spec, has_test)
 
         if p.just_evaluate:
             return self._finalize_device(
@@ -672,11 +782,17 @@ class GBDTTrainer:
             )
 
         carry = (scores, scores_t, bufs, loss_buf, tloss_buf)
+        jit_round, spec = self._probe_compile(
+            jit_round, carry, data, dd, has_test, spec, start_round
+        )
+        self.grow_spec = spec  # what actually ran (after any downgrade)
         carry = self._run_rounds(
             jit_round, carry, data, dd, model, train.feature_names,
             start_round, has_test, t0, ts,
         )
         scores, scores_t, bufs, loss_buf, tloss_buf = carry
+        self.wave_log = np.asarray(jax.device_get(bufs["wlog"]))
+        self._export_wave_stats(ts, dd, spec)
         t_fin = time.time()
         out = self._finalize_device(
             model, bins, scores, y, weight, scores_t, y_t, w_t,

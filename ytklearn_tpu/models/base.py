@@ -84,15 +84,20 @@ class ConvexModel:
         with latent gathers (FM/FFM/GBST) override with their real cost."""
         return -(-width // 128) * 128 * 4
 
-    def suggest_row_chunk(self, n_rows: int, width: int) -> Optional[int]:
+    def suggest_row_chunk(
+        self, n_rows: int, width: int, n_shards: int = 1
+    ) -> Optional[int]:
         """Row chunk for blocked loss/grad/score evaluation, or None when
         the whole batch fits the budget (the reference's blocked-CoreData
         contract, dataflow/CoreData.java:51-52; env overrides YTK_ROW_CHUNK
-        / YTK_CHUNK_BUDGET_MB)."""
+        / YTK_CHUNK_BUDGET_MB). `n_shards`: mesh shard count — the chunk
+        decision is per-shard (each shard scans only its rows)."""
         from ..optimize.blocked import suggest_chunk
 
         # x4: forward intermediate + its backward cotangents/temps
-        return suggest_chunk(n_rows, 4 * self.score_bytes_per_row(width))
+        return suggest_chunk(
+            n_rows, 4 * self.score_bytes_per_row(width), n_shards=n_shards
+        )
 
     # kernels ------------------------------------------------------------
     def pure_loss(self, w, *batch):

@@ -31,11 +31,15 @@ from jax.sharding import PartitionSpec as P
 
 def _to_varying(x, axes):
     """Mark x varying over the given mesh axes (shard_map vma typing).
-    jax 0.9 deprecates lax.pvary in favor of lax.pcast(..., to="varying")."""
+    jax 0.9 deprecates lax.pvary in favor of lax.pcast(..., to="varying");
+    pre-vma jax (0.4.x) has neither and needs no marking — identity."""
     pc = getattr(jax.lax, "pcast", None)
     if pc is not None:
         return pc(x, axes, to="varying")
-    return jax.lax.pvary(x, axes)
+    pv = getattr(jax.lax, "pvary", None)
+    if pv is not None:
+        return pv(x, axes)
+    return x
 
 
 def _split(batch, row_mask):
@@ -53,8 +57,16 @@ def _stack_chunks(rows, chunk: int):
     """Pad row arrays to a multiple of `chunk` and reshape to
     (n_chunks, chunk, ...). Padding rows are all-zero — ingest already pads
     with zero-weight rows, and every model loss masks weight==0 rows, so
-    padded rows contribute exactly 0 to loss and gradient."""
+    padded rows contribute exactly 0 to loss and gradient.
+
+    A chunk is NEVER padded beyond the data: chunking exists to cap memory
+    on large n, not to tax small n (reference contract: blocks cap memory,
+    optimizer/FMHoagOptimizer.java:88). Under shard_map n is the SHARD's
+    row count, so a small per-shard slice of a big batch — the r5
+    eval-amplification bug, ~20x compute per line-search trial on the
+    8-device test mesh — collapses to one exact-size chunk here."""
     n = rows[0].shape[0]
+    chunk = min(chunk, n)
     nc = -(-n // chunk)
     pad = nc * chunk - n
 
@@ -160,7 +172,7 @@ def mesh_chunked_value_and_grad(
     psum over the data axis — the reference's grad allreduce
     (optimizer/HoagOptimizer.java:1038) with the block loop inside each
     rank, matching its per-thread CoreData block walk."""
-    from jax import shard_map
+    from ..parallel.mesh import shard_map_compat as shard_map
 
     mask = tuple(row_mask) if row_mask is not None else (True,) * n_batch
     cvg = chunked_value_and_grad(fn, chunk, mask, vary_axes=(axis,))
@@ -186,7 +198,7 @@ def mesh_chunked_sum(
     """`chunked_sum` per shard under shard_map + psum. Reshaping a
     row-sharded global array for the plain scan would make XLA all-gather
     the batch onto every device — this keeps each shard's chunks local."""
-    from jax import shard_map
+    from ..parallel.mesh import shard_map_compat as shard_map
 
     mask = tuple(row_mask) if row_mask is not None else (True,) * n_batch
     cs = chunked_sum(fn, chunk, mask, vary_axes=(axis,))
@@ -209,7 +221,7 @@ def mesh_blocked_rows(
 ) -> Callable:
     """`blocked_rows` per shard under shard_map — per-row outputs stay
     row-sharded (out_specs P(axis)), no collective needed."""
-    from jax import shard_map
+    from ..parallel.mesh import shard_map_compat as shard_map
 
     mask = tuple(row_mask) if row_mask is not None else (True,) * n_batch
     br = blocked_rows(fn, chunk, mask)
@@ -262,18 +274,32 @@ def suggest_chunk(
     bytes_per_row: int,
     budget_bytes: Optional[int] = None,
     min_chunk: int = 4096,
+    n_shards: int = 1,
 ) -> Optional[int]:
     """Pick a power-of-two row chunk so the score intermediates stay under
     `budget_bytes` (default 1 GiB, env YTK_CHUNK_BUDGET_MB). Returns None
-    when the whole batch already fits (no chunking needed)."""
+    when the whole batch already fits (no chunking needed).
+
+    All decisions are made on the PER-SHARD row count (`n_rows` is the
+    global batch; on a mesh each shard scans its own rows): a shard at or
+    under `min_chunk` rows never chunks — chunking exists to cap memory on
+    large n, never to tax small n. The r5 regression this guards against:
+    FFM's padded per-row estimate forced chunking at ~1.6k global rows,
+    and each 200-row test-mesh shard was padded to a 4096-row chunk —
+    ~20x compute amplification per line-search trial (test_ffm_agaricus
+    3088 s). Now: local_rows <= min_chunk -> None."""
     import os
 
+    local_rows = -(-n_rows // max(n_shards, 1))
     if budget_bytes is None:
         budget_bytes = int(os.environ.get("YTK_CHUNK_BUDGET_MB", "1024")) << 20
     env = os.environ.get("YTK_ROW_CHUNK")
     if env is not None:
         chunk = int(env)
-        return chunk if 0 < chunk < n_rows else None
-    if n_rows * bytes_per_row <= budget_bytes:
+        return chunk if 0 < chunk < local_rows else None
+    if local_rows <= min_chunk:
         return None
-    return max(min_chunk, pow2_floor(budget_bytes // max(bytes_per_row, 1)))
+    if local_rows * bytes_per_row <= budget_bytes:
+        return None
+    chunk = max(min_chunk, pow2_floor(budget_bytes // max(bytes_per_row, 1)))
+    return chunk if chunk < local_rows else None

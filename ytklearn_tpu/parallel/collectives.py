@@ -69,7 +69,7 @@ def pargmax_tuple(score, payload, axis_name: str = DATA_AXIS):
     Returns (best_score, best_payload) replicated on all ranks.
     """
     idx = lax.axis_index(axis_name)
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     # NaN scores (split gains can be NaN from 0/0 hessian sums) are treated
     # as -inf so they can never win and never poison the pmax — HLO maximum
     # is NaN-propagating on some backends (VERDICT r1 Weak #4). All ranks
@@ -96,7 +96,10 @@ def axis_index(axis_name: str = DATA_AXIS):
 
 
 def axis_size(axis_name: str = DATA_AXIS):
-    return lax.axis_size(axis_name)
+    fn = getattr(lax, "axis_size", None)  # absent pre-0.5 jax
+    if fn is not None:
+        return fn(axis_name)
+    return lax.psum(1, axis_name)  # constant-folds to the axis size
 
 
 # ---------------------------------------------------------------------------

@@ -182,7 +182,10 @@ class HoagTrainer:
         # intermediates (FM/FFM latent gathers) never scale peak memory
         # with n (reference blocked-CoreData contract, CoreData.java:51-52)
         width = int(train_b[0].shape[1]) if train_b[0].ndim > 1 else 1
-        row_chunk = model.suggest_row_chunk(int(train_b[0].shape[0]), width)
+        row_chunk = model.suggest_row_chunk(
+            int(train_b[0].shape[0]), width,
+            n_shards=int(self.mesh.devices.size) if self.mesh is not None else 1,
+        )
         row_mask = model.batch_row_mask
         # mesh-aware when sharded: chunks stay shard-local (a plain scan on
         # a row-sharded array would all-gather the batch onto every device)
