@@ -47,11 +47,21 @@ YTK_PARTITION / YTK_LADDER / YTK_FUSED / YTK_FUSED_MAX_ROWS.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import sys
 import time
 
 import numpy as np
+
+from ytklearn_tpu import obs
+
+log = logging.getLogger("ytklearn_tpu.bench")
+
+#: bench JSON schema: 1 = the flat pre-obs shape (BENCH_r01..r05), 2 adds
+#: schema_version + the obs snapshot block (counters/gauges incl. AOT
+#: downgrade events). scripts/ablate_engine.py::read_bench_record reads both.
+BENCH_SCHEMA_VERSION = 2
 
 # per-chip peaks for the achieved-vs-peak fields (dense MXU throughput /
 # HBM bandwidth; public spec-sheet numbers)
@@ -142,7 +152,7 @@ def resolve_gbdt_data(n: int, n_test: int):
     pinned drift band for synthetic."""
     d = higgs_dir()
     if has_real_higgs(d):
-        print(f"loading real Higgs from {d}", file=sys.stderr)
+        log.info("loading real Higgs from %s", d)
         train, test = _load_real_higgs(d)
         return train, test, "higgs"
     train, test = _gen_gbdt(n, n_test, F=28)
@@ -178,10 +188,29 @@ def quality_band(source: str, auc: float, logloss: float, knobs_set: bool):
     return "ok"
 
 
-def roofline_fields(trainer, n_trees: int) -> dict:
-    """Achieved-vs-peak utilization + per-phase seconds from the trainer's
-    time_stats and the engine's device wave log."""
-    ts = dict(trainer.time_stats)
+def gbdt_stats_from_obs(trainer=None, snapshot=None) -> dict:
+    """The GBDT run stats in time_stats shape, read from the obs registry
+    snapshot (`gbdt.stat.*` gauges the trainer publishes) — bench derives
+    its roofline from the SAME registry every production run reports from.
+    Falls back to trainer.time_stats when obs is disabled."""
+    gauges = (snapshot or obs.snapshot())["gauges"]
+    stats = {
+        k[len("gbdt.stat."):]: v
+        for k, v in gauges.items()
+        if k.startswith("gbdt.stat.")
+    }
+    if not stats and trainer is not None:
+        stats = {
+            k: v for k, v in trainer.time_stats.items()
+            if isinstance(v, (bool, int, float))
+        }
+    return stats
+
+
+def roofline_fields(stats: dict, n_trees: int) -> dict:
+    """Achieved-vs-peak utilization + per-phase seconds from the obs stats
+    snapshot (gbdt_stats_from_obs) and the engine's device wave log."""
+    ts = dict(stats)
     chip = os.environ.get("YTK_CHIP", "v5e")
     peaks = CHIP_PEAKS.get(chip, CHIP_PEAKS["v5e"])
     hist = os.environ.get("BENCH_HIST", "int8")
@@ -231,7 +260,7 @@ def bench_gbdt() -> dict:
     # real data asserts the reference band, which is defined at the full
     # 500-tree config; synthetic keeps the fast 40-tree default
     n_trees = int(os.environ.get("BENCH_TREES", 500 if source == "higgs" else 40))
-    print(f"data ({source}) {time.time()-t0:.1f}s", file=sys.stderr)
+    log.info("data (%s) %.1fs", source, time.time() - t0)
 
     params = GBDTParams(
         round_num=n_trees,
@@ -269,7 +298,7 @@ def bench_gbdt() -> dict:
         "logloss": float(res.test_loss) if res.test_loss is not None else float("nan"),
         "trees": n_trees,
         "source": source,
-        "roofline": roofline_fields(trainer, n_trees),
+        "roofline": roofline_fields(gbdt_stats_from_obs(trainer), n_trees),
     }
 
 
@@ -310,7 +339,7 @@ def bench_fm() -> dict:
     # at this scale is 39.9 GB lane-padded — the BENCH_r04 OOM; chunked it
     # compiles at <4 GB total (AOT memory_analysis-verified on the v5e chip)
     row_chunk = model.suggest_row_chunk(n, nnz)
-    print(f"fm row chunk: {row_chunk}", file=sys.stderr)
+    log.info("fm row chunk: %s", row_chunk)
 
     def run(iters):
         res = minimize_lbfgs(
@@ -334,6 +363,17 @@ def bench_fm() -> dict:
 def main() -> None:
     import jax
 
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+        stream=sys.stderr,
+    )
+    # every bench run collects obs (roofline + downgrade visibility);
+    # YTK_TRACE=path additionally writes the Perfetto trace at exit.
+    # YTK_OBS=0 stays the documented force-off (overhead A/B runs) — the
+    # roofline then falls back to trainer.time_stats.
+    if os.environ.get("YTK_OBS") != "0":
+        obs.configure(enabled=True)
     os.makedirs(".jax_cache", exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", os.path.abspath(".jax_cache"))
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
@@ -341,6 +381,7 @@ def main() -> None:
     g = bench_gbdt()
     ref_trees_per_sec = 0.88  # docs/gbdt_experiments.md, 500 trees / 567.83s
     out = {
+        "schema_version": BENCH_SCHEMA_VERSION,
         "metric": "gbdt_trees_per_sec_higgs10.5M_losswise_255leaves",
         "value": round(g["trees_per_sec"], 3),
         "unit": "trees/s",
@@ -371,6 +412,15 @@ def main() -> None:
             out["fm_loss"] = round(f["fm_loss"], 4)
         except Exception as e:  # noqa: BLE001
             out["fm_error"] = f"{type(e).__name__}: {e}"[:300]
+    # obs snapshot block: one registry for bench + production reporting.
+    # Downgrade counters surface silent Mosaic fused->XLA->full-scan
+    # fallbacks right in the artifact.
+    snap = obs.snapshot()
+    out["obs"] = {
+        "counters": {k: round(v, 3) for k, v in sorted(snap["counters"].items())},
+        "gauges": {k: round(v, 4) for k, v in sorted(snap["gauges"].items())},
+    }
+    out["downgrades"] = int(snap["counters"].get("gbdt.downgrade.total", 0))
     print(json.dumps(out))
     if band_fail:
         sys.exit(1)

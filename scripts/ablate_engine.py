@@ -12,7 +12,9 @@ Usage: python scripts/ablate_engine.py [n_rows] [config ...]
            part / nopart (leaf-partitioned phases on/off A/B),
            fused / nofused (fused gather kernel vs XLA gather, TPU)
 Env: ABLATE_TREES (default 10), ABLATE_RECORD=path to also write the
-wave-log ablation artifact as JSON (e.g. ABLATION_r06.json).
+wave-log ablation artifact as JSON (e.g. ABLATION_r06.json),
+ABLATE_BASELINE=path to a checked-in BENCH_*.json (any schema generation
+— read_bench_record normalizes) to print a vs-baseline line per config.
 """
 
 from __future__ import annotations
@@ -48,6 +50,31 @@ def _apply_env(cfg: str):
         os.environ[k] = v
 
 
+def read_bench_record(path: str) -> dict:
+    """Load a BENCH_*.json artifact, tolerating every schema generation:
+    v1 (BENCH_r01..r05 — flat fields, no schema_version) and v2+
+    (schema_version + the obs counters/gauges block). Returns a normalized
+    dict; absent fields come back as None/empty."""
+    with open(path) as f:
+        rec = json.load(f)
+    obs_block = rec.get("obs") or {}
+    counters = obs_block.get("counters") or {}
+    return {
+        "schema_version": int(rec.get("schema_version", 1)),
+        "trees_per_sec": rec.get("value"),
+        "auc": rec.get("auc"),
+        "logloss": rec.get("logloss"),
+        "trees": rec.get("trees"),
+        "mxu_pct_peak": rec.get("mxu_pct_peak"),
+        "hbm_pct_peak": rec.get("hbm_pct_peak"),
+        "downgrades": rec.get(
+            "downgrades", int(counters.get("gbdt.downgrade.total", 0))
+        ),
+        "obs": obs_block,
+        "raw": rec,
+    }
+
+
 def wave_table(wave_log: np.ndarray, tree: int = -1):
     """[(rows_scanned, rows_needed, splits, width)] for one tree — the
     O(wave rows) evidence table."""
@@ -71,6 +98,15 @@ def main() -> None:
     configs = sys.argv[2:] or ["b256"]
     n_trees = int(os.environ.get("ABLATE_TREES", 10))
     record_path = os.environ.get("ABLATE_RECORD")
+    baseline = None
+    if os.environ.get("ABLATE_BASELINE"):
+        baseline = read_bench_record(os.environ["ABLATE_BASELINE"])
+        print(
+            f"baseline {os.environ['ABLATE_BASELINE']} "
+            f"(schema v{baseline['schema_version']}): "
+            f"{baseline['trees_per_sec']} trees/s",
+            flush=True,
+        )
     F = 28
 
     key = jax.random.PRNGKey(0)
@@ -111,11 +147,17 @@ def main() -> None:
         tr.train(train=train)
         stats = {k: round(v, 1) for k, v in tr.time_stats.items()
                  if isinstance(v, float)}
+        steady = tr.time_stats.get("trees_per_sec_steady", 0)
         print(
-            f"CONFIG {cfg}: steady={tr.time_stats.get('trees_per_sec_steady', 0):.3f}"
-            f" trees/s  stats={stats}",
+            f"CONFIG {cfg}: steady={steady:.3f} trees/s  stats={stats}",
             flush=True,
         )
+        if baseline and baseline.get("trees_per_sec"):
+            print(
+                f"CONFIG {cfg}: vs baseline "
+                f"{steady / baseline['trees_per_sec']:.2f}x",
+                flush=True,
+            )
         entry = {
             "steady_trees_per_sec": tr.time_stats.get("trees_per_sec_steady", 0.0),
             "time_stats": {

@@ -82,6 +82,22 @@ def _load_hook(need: bool, script: str):
     return load_transform_hook(script)
 
 
+def _setup_trace(trace_out: str) -> None:
+    """--trace-out: enable obs + register the Chrome-trace export."""
+    if trace_out:
+        from . import obs
+
+        obs.configure(enabled=True, trace_path=trace_out)
+
+
+def _flush_trace(trace_out: str) -> None:
+    """Write the trace now — *_main may be driven in-process (no atexit)."""
+    if trace_out:
+        from . import obs
+
+        obs.flush()
+
+
 def train_main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="ytklearn-tpu-train",
@@ -105,9 +121,14 @@ def train_main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--process-id", type=int, default=-1)
     ap.add_argument("--set", action="append", dest="sets", metavar="KEY=VALUE",
                     help="config override, repeatable")
+    ap.add_argument("--trace-out", default="",
+                    help="write a Chrome-trace/Perfetto JSON of the run to "
+                    "this path (YTK_TRACE=path everywhere else; see "
+                    "docs/observability.md)")
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args(argv)
     _setup_logging(args.verbose)
+    _setup_trace(args.trace_out)
 
     import os as _os
 
@@ -157,7 +178,9 @@ def train_main(argv: Optional[List[str]] = None) -> int:
         restarts = 0
     for attempt in range(restarts + 1):
         try:
-            return _train_once(name, cfg, mesh, hook)
+            rc = _train_once(name, cfg, mesh, hook)
+            _flush_trace(args.trace_out)
+            return rc
         except KeyboardInterrupt:
             raise
         except Exception:
@@ -249,9 +272,13 @@ def predict_main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--eval-metric", default="", help='e.g. "auc,mae"')
     ap.add_argument("--predict-type", default="value", choices=("value", "leafid"))
     ap.add_argument("--set", action="append", dest="sets", metavar="KEY=VALUE")
+    ap.add_argument("--trace-out", default="",
+                    help="write a Chrome-trace/Perfetto JSON of the batch "
+                    "predict to this path")
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args(argv)
     _setup_logging(args.verbose)
+    _setup_trace(args.trace_out)
 
     from .config import hocon
     from .predict import batch_predict_from_files, create_predictor
@@ -272,6 +299,7 @@ def predict_main(argv: Optional[List[str]] = None) -> int:
         predict_type_str=args.predict_type,
         K=K,
     )
+    _flush_trace(args.trace_out)
     print(json.dumps({"model": args.model_name, "avg_loss": avg_loss}))
     return 0
 

@@ -399,8 +399,12 @@ class GBDTIngest:
     def load(self) -> Tuple[GBDTData, Optional[GBDTData]]:
         import jax
 
+        from ..obs import inc as obs_inc, span as obs_span
+
         p = self.params
-        train = self._parse(p.data.train_paths, p.data.train_max_error_tol)
+        with obs_span("ingest.parse", split="train", path="gbdt"):
+            train = self._parse(p.data.train_paths, p.data.train_max_error_tol)
+        obs_inc("ingest.rows", train.n_real)
         # raise on ALL ranks (a single-rank raise would leave the peers
         # blocked inside the next allgather collective)
         from ..parallel.collectives import host_allgather_objects
@@ -419,10 +423,12 @@ class GBDTIngest:
         _apply_fill(train.X, fill)
         test = None
         if p.data.test_paths:
-            test = self._parse(
-                p.data.test_paths, p.data.test_max_error_tol,
-                fmap=self._fmap, frozen=True,
-            )
+            with obs_span("ingest.parse", split="test", path="gbdt"):
+                test = self._parse(
+                    p.data.test_paths, p.data.test_max_error_tol,
+                    fmap=self._fmap, frozen=True,
+                )
+            obs_inc("ingest.rows", test.n_real)
             test.missing_fill = fill
             _apply_fill(test.X, fill)
         return train, test

@@ -320,13 +320,11 @@ def _build_grow(spec: GrowSpec, n_shards: int = 1, axis: str = "data"):
     iota_m = jnp.arange(M, dtype=jnp.int32)
 
     if n_shards > 1:
-        from ..parallel.collectives import pargmax_tuple
+        from ..parallel.collectives import pargmax_tuple, psum_scatter
 
         def combine_hist(local):
             """Partial (N, F, B, 3|i32) -> globally-summed owned F-slice."""
-            return jax.lax.psum_scatter(
-                local, axis, scatter_dimension=1, tiled=True
-            )
+            return psum_scatter(local, axis, tiled=True, scatter_dimension=1)
 
         def best_splits(hists, fmask_loc):
             """split_kernel on the owned slice + global pargmax merge.
@@ -423,8 +421,10 @@ def _build_grow(spec: GrowSpec, n_shards: int = 1, axis: str = "data"):
             hmax = jnp.max(jnp.abs(h))
             if n_shards > 1:
                 # one global scale pair so quantized partials sum exactly
-                gmax = jax.lax.pmax(gmax, axis)
-                hmax = jax.lax.pmax(hmax, axis)
+                from ..parallel.collectives import pmax
+
+                gmax = pmax(gmax, axis)
+                hmax = pmax(hmax, axis)
             sg = qmax / jnp.maximum(gmax, 1e-12)
             sh = qmax / jnp.maximum(hmax, 1e-12)
             gq = jnp.clip(jnp.round(g * sg), -qmax, qmax)  # f32 integers:
@@ -529,7 +529,9 @@ def _build_grow(spec: GrowSpec, n_shards: int = 1, axis: str = "data"):
         hist0 = hist_call(pos_fit, ids0)  # (1, F_loc, B, 3)
         root_ghc = jnp.sum(hist0[0, 0], axis=0)  # feature 0 bin-sum = totals
         if n_shards > 1:
-            root_ghc = jax.lax.psum(
+            from ..parallel.collectives import psum
+
+            root_ghc = psum(
                 jnp.where(jax.lax.axis_index(axis) == 0, root_ghc, 0.0), axis
             )
         tr = tr._replace(

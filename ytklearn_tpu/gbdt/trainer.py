@@ -47,6 +47,13 @@ from ..config.params import GBDTParams
 from ..eval import EvalSet
 from ..io.fs import FileSystem, LocalFileSystem
 from ..losses import create_loss
+from ..obs import (
+    enabled as obs_enabled,
+    event as obs_event,
+    gauge as obs_gauge,
+    inc as obs_inc,
+    span as obs_span,
+)
 from ..parallel.mesh import row_sharding
 from .binning import (
     FeatureBins,
@@ -615,20 +622,34 @@ class GBDTTrainer:
         )
         downgrades = []
         if spec.partition and spec.fused:
-            downgrades.append(({"fused": False}, "XLA-gather partitioned phases"))
+            downgrades.append(
+                ({"fused": False}, "XLA-gather partitioned phases", "fused_to_xla")
+            )
         if spec.partition:
-            downgrades.append(({"partition": False}, "full-scan histograms"))
+            downgrades.append(
+                ({"partition": False}, "full-scan histograms",
+                 "partition_to_fullscan")
+            )
         while True:
             try:
                 return jit_round.lower(*args).compile(), spec
             except Exception as e:  # noqa: BLE001 — downgrade on any compile failure
                 if not downgrades:
                     raise
-                change, label = downgrades.pop(0)
+                change, label, kind = downgrades.pop(0)
                 log.warning(
                     "device round program failed to compile (%s: %.300s); "
                     "retrying with %s",
                     type(e).__name__, e, label,
+                )
+                # silent-Mosaic-fallback visibility: every AOT-probe
+                # downgrade is a named counter + trace event, so bench JSON
+                # (obs block) shows exactly which rungs were lost
+                obs_inc("gbdt.downgrade.total")
+                obs_inc(f"gbdt.downgrade.{kind}")
+                obs_event(
+                    "gbdt.downgrade", kind=kind,
+                    error=f"{type(e).__name__}: {e}"[:200],
                 )
                 spec = dataclasses.replace(spec, **change)
                 jit_round = self._build_round_step(dd, spec, has_test)
@@ -666,6 +687,32 @@ class GBDTTrainer:
             spec.partition and spec.fused
             and (not spec.force_dense or spec.fused_interpret)
         )
+        self._publish_wave_obs(wl, used)
+
+    def _publish_wave_obs(self, wl, used) -> None:
+        """Accumulate the wave log into obs counters ONCE PER TREE (the
+        registry is the shared source bench and any report reads; the
+        per-tree granularity keeps tree-level events available without a
+        second device fetch — `wl` is the single end-of-run fetch)."""
+        if not obs_enabled():
+            return
+        for t in range(wl.shape[0]):
+            u = used[t]
+            waves = float(u.sum())
+            if not waves:
+                continue
+            scanned = float((wl[t, :, 0] * u).sum())
+            needed = float((wl[t, :, 1] * u).sum())
+            splits = float((wl[t, :, 2] * u).sum())
+            obs_inc("gbdt.trees")
+            obs_inc("gbdt.waves", waves)
+            obs_inc("gbdt.hist_rows_scanned", scanned)
+            obs_inc("gbdt.hist_rows_needed", needed)
+            obs_inc("gbdt.splits", splits)
+            obs_event(
+                "gbdt.tree", tree=t, waves=waves, rows_scanned=scanned,
+                rows_needed=needed, splits=splits,
+            )
 
     def _run_rounds(
         self, jit_round, carry, data, dd, model, feature_names,
@@ -699,9 +746,13 @@ class GBDTTrainer:
             Tuple[int, jnp.ndarray, Optional[jnp.ndarray], float]
         ] = None
         for rnd in range(start_round, p.round_num):
-            carry = jit_round(
-                carry, jnp.asarray(rnd), jax.random.fold_in(root_key, rnd), data
-            )
+            # enqueue-side span: the round program is async, so this
+            # measures dispatch (device time shows up in the sync spans)
+            with obs_span("gbdt.round", round=rnd):
+                carry = jit_round(
+                    carry, jnp.asarray(rnd), jax.random.fold_in(root_key, rnd), data
+                )
+            obs_inc("gbdt.rounds")
             if (rnd + 1) % sync_every == 0 or rnd == p.round_num - 1:
                 if watch_eval is None:
                     nxt = (
@@ -744,11 +795,13 @@ class GBDTTrainer:
         t0 = time.time()
         ts = self.time_stats = {}  # TimeStats equivalent (data/gbdt/TimeStats.java)
         if train is None:
-            train, test = GBDTIngest(p, self.fs).load()
+            with obs_span("gbdt.load"):
+                train, test = GBDTIngest(p, self.fs).load()
         ts["load"] = time.time() - t0
         K = self.K
 
-        dd = self._prep_device_inputs(train, test)
+        with obs_span("gbdt.preprocess", F=train.n_features):
+            dd = self._prep_device_inputs(train, test)
         bins = dd.bins
         y, weight, y_t, w_t = dd.y, dd.weight, dd.y_t, dd.w_t
         ts["preprocess"] = time.time() - t0 - ts["load"]
@@ -786,19 +839,21 @@ class GBDTTrainer:
             jit_round, carry, data, dd, has_test, spec, start_round
         )
         self.grow_spec = spec  # what actually ran (after any downgrade)
-        carry = self._run_rounds(
-            jit_round, carry, data, dd, model, train.feature_names,
-            start_round, has_test, t0, ts,
-        )
+        with obs_span("gbdt.train", rounds=p.round_num - start_round):
+            carry = self._run_rounds(
+                jit_round, carry, data, dd, model, train.feature_names,
+                start_round, has_test, t0, ts,
+            )
         scores, scores_t, bufs, loss_buf, tloss_buf = carry
         self.wave_log = np.asarray(jax.device_get(bufs["wlog"]))
         self._export_wave_stats(ts, dd, spec)
         t_fin = time.time()
-        out = self._finalize_device(
-            model, bins, scores, y, weight, scores_t, y_t, w_t,
-            bufs, loss_buf, tloss_buf, start_round, train.feature_names, t0,
-            trained_rounds=p.round_num,
-        )
+        with obs_span("gbdt.finalize"):
+            out = self._finalize_device(
+                model, bins, scores, y, weight, scores_t, y_t, w_t,
+                bufs, loss_buf, tloss_buf, start_round, train.feature_names, t0,
+                trained_rounds=p.round_num,
+            )
         ts["finalize"] = time.time() - t_fin
         log.info(
             "[time stats] load=%.1fs preprocess=%.1fs train=%.1fs "
@@ -809,6 +864,12 @@ class GBDTTrainer:
                 if "trees_per_sec_steady" in ts else ""
             ),
         )
+        # mirror every scalar time_stat into the registry (gbdt.stat.*) —
+        # the ONE snapshot bench roofline accounting reads, so benchmarks
+        # and production runs report from the same source of truth
+        for k, v in ts.items():
+            if isinstance(v, (bool, int, float)):
+                obs_gauge(f"gbdt.stat.{k}", float(v))
         return out
 
     def _emit_sync(self, pending, t0) -> None:
@@ -818,7 +879,9 @@ class GBDTTrainer:
         absolute per-round times late (steady-state trees/s uses diffs and
         is insensitive either way)."""
         rnd, loss_dev, tloss_dev, t_sync = pending
-        tl = float(loss_dev)  # completed a window ago: one RTT, no stall
+        obs_inc("gbdt.syncs")
+        with obs_span("gbdt.sync", round=rnd, lagged=True):
+            tl = float(loss_dev)  # completed a window ago: one RTT, no stall
         elapsed = t_sync - t0
         self.sync_log.append((rnd, elapsed))
         msg = f"[round={rnd}] {elapsed:.1f}s train loss={tl:.6f}"
@@ -833,7 +896,9 @@ class GBDTTrainer:
         The final round skips the watch log: _finalize_device evaluates
         the same final scores anyway."""
         p = self.params
-        tl = float(carry[3][rnd])  # syncs the pipeline
+        obs_inc("gbdt.syncs")
+        with obs_span("gbdt.sync", round=rnd, lagged=False):
+            tl = float(carry[3][rnd])  # syncs the pipeline
         elapsed = time.time() - t0
         self.sync_log.append((rnd, elapsed))
         msg = f"[round={rnd}] {elapsed:.1f}s train loss={tl:.6f}"
@@ -1270,6 +1335,7 @@ class GBDTTrainer:
                 fmask[rng.randint(F)] = True
             fmask_dev = jnp.asarray(fmask)
 
+            obs_inc("gbdt.rounds")
             for grp in range(K):
                 g = (gs[:, grp] if K > 1 else gs) * weight
                 h = (hs[:, grp] if K > 1 else hs) * weight

@@ -15,6 +15,7 @@ from __future__ import annotations
 import logging
 from typing import Dict, Optional
 
+from ..obs import heartbeat as obs_heartbeat, inc as obs_inc, span as obs_span
 from .fs import FileSystem, LocalFileSystem
 
 log = logging.getLogger("ytklearn_tpu.libsvm")
@@ -48,8 +49,13 @@ def convert_libsvm(
 
     cnt = 0
     kcnt = [0] * max(len(label_map), 1)
-    with fs.open(output_path, "w") as out:
+    hb = obs_heartbeat("libsvm.convert", every_s=30.0)
+    with obs_span("ingest.convert", input=input_path), fs.open(
+        output_path, "w"
+    ) as out:
         for line in fs.read_lines([input_path]):
+            if cnt and cnt & 0xFFFF == 0:
+                hb.beat(lines=cnt)
             line = line.strip()
             if not line:
                 continue
@@ -72,6 +78,7 @@ def convert_libsvm(
                 kvs.append(f"{name}{feature_name_val_delim}{val}")
             out.write(x_delim.join(parts + [features_delim.join(kvs)]) + "\n")
             cnt += 1
+    obs_inc("ingest.converted_lines", cnt)
     if label_map:
         log.info("converted %d lines, per-label counts: %s", cnt, kcnt)
     else:

@@ -26,6 +26,7 @@ from typing import Callable, Dict, Iterable, Iterator, List, Optional, Sequence,
 import numpy as np
 
 from ..config.params import CommonParams, DelimParams
+from ..obs import heartbeat as obs_heartbeat, inc as obs_inc, span as obs_span
 from .feature_hash import FeatureHash
 from .fs import FileSystem, LocalFileSystem
 
@@ -340,7 +341,10 @@ class DataIngest:
         ys = dict(self.params.data.y_sampling)
         rows: List[ParsedLine] = []
         errors = 0
+        hb = obs_heartbeat("ingest.parse", every_s=30.0)
         for raw in lines:
+            if len(rows) & 0xFFFF == 0 and rows:
+                hb.beat(rows=len(rows), errors=errors)
             if not raw.strip():
                 continue
             for line in (
@@ -372,6 +376,8 @@ class DataIngest:
                         raise
                     continue
                 rows.append(pl)
+        obs_inc("ingest.rows_parsed", len(rows))
+        obs_inc("ingest.error_lines", errors)
         return rows
 
     # -- dict -----------------------------------------------------------
@@ -611,21 +617,29 @@ class DataIngest:
         def read(paths: Sequence[str]) -> Iterator[str]:
             return shard_read_lines(self.fs, p.data, paths)
 
-        train_rows = self.parse_rows(
-            read(p.data.train_paths), p.data.train_max_error_tol, is_train=True
-        )
-        fmap = self._resolve_feature_map(lambda: _counts_from_rows(train_rows))
-        nodes = self.compute_transform_nodes(train_rows, fmap)
-        if nodes:
-            self.write_transform_sidecar(nodes, fmap)
+        with obs_span("ingest.parse", split="train", path="python"):
+            train_rows = self.parse_rows(
+                read(p.data.train_paths), p.data.train_max_error_tol, is_train=True
+            )
+        with obs_span("ingest.dict"):
+            fmap = self._resolve_feature_map(lambda: _counts_from_rows(train_rows))
+        with obs_span("ingest.transform"):
+            nodes = self.compute_transform_nodes(train_rows, fmap)
+            if nodes:
+                self.write_transform_sidecar(nodes, fmap)
 
-        train = self.to_dataset(train_rows, fmap, nodes)
+        with obs_span("ingest.materialize", split="train"):
+            train = self.to_dataset(train_rows, fmap, nodes)
+        obs_inc("ingest.rows", train.n_real)
         test = None
         if p.data.test_paths:
-            test_rows = self.parse_rows(
-                read(p.data.test_paths), p.data.test_max_error_tol, is_train=False
-            )
-            test = self.to_dataset(test_rows, fmap, nodes)
+            with obs_span("ingest.parse", split="test", path="python"):
+                test_rows = self.parse_rows(
+                    read(p.data.test_paths), p.data.test_max_error_tol, is_train=False
+                )
+            with obs_span("ingest.materialize", split="test"):
+                test = self.to_dataset(test_rows, fmap, nodes)
+            obs_inc("ingest.rows", test.n_real)
 
         # global label stats (reference: CoreData.globalSync y stats)
         K = max(self.n_labels, 2)
@@ -730,6 +744,8 @@ class DataIngest:
                 f"({max_error_tol})"
             )
 
+        obs_inc("ingest.rows_parsed", float(keep.sum()))
+        obs_inc("ingest.error_lines", float(n_errors))
         new_row = np.cumsum(keep) - 1
         occ_keep = keep[occ_row]
         return _Cols(
@@ -824,15 +840,17 @@ class DataIngest:
         """Columnar loadFlow over the native parser — same pipeline, same
         results as _load_python, numpy-vectorized end to end."""
         p = self.params
-        train = self._parse_cols(
-            p.data.train_paths, p.data.train_max_error_tol, is_train=True
-        )
+        with obs_span("ingest.parse", split="train", path="native"):
+            train = self._parse_cols(
+                p.data.train_paths, p.data.train_max_error_tol, is_train=True
+            )
 
         def counts() -> Dict[str, int]:
             c = np.bincount(train.occ_name, minlength=len(train.names))
             return {nm: int(c[i]) for i, nm in enumerate(train.names) if c[i] > 0}
 
-        fmap = self._resolve_feature_map(counts)
+        with obs_span("ingest.dict"):
+            fmap = self._resolve_feature_map(counts)
 
         nodes: Dict[int, TransformNode] = {}
         if p.feature.transform.switch_on:
@@ -854,13 +872,18 @@ class DataIngest:
             if nodes:
                 self.write_transform_sidecar(nodes, fmap)
 
-        train_ds = self._cols_to_dataset(train, fmap, nodes)
+        with obs_span("ingest.materialize", split="train"):
+            train_ds = self._cols_to_dataset(train, fmap, nodes)
+        obs_inc("ingest.rows", train_ds.n_real)
         test_ds = None
         if p.data.test_paths:
-            test = self._parse_cols(
-                p.data.test_paths, p.data.test_max_error_tol, is_train=False
-            )
-            test_ds = self._cols_to_dataset(test, fmap, nodes)
+            with obs_span("ingest.parse", split="test", path="native"):
+                test = self._parse_cols(
+                    p.data.test_paths, p.data.test_max_error_tol, is_train=False
+                )
+            with obs_span("ingest.materialize", split="test"):
+                test_ds = self._cols_to_dataset(test, fmap, nodes)
+            obs_inc("ingest.rows", test_ds.n_real)
 
         # global label stats (CoreData.globalSync y stats)
         K = max(self.n_labels, 2)

@@ -1,0 +1,38 @@
+"""ytklearn_tpu.obs — unified tracing/metrics subsystem.
+
+Public surface (see docs/observability.md):
+
+  span(name, settle=None, **attrs)   nested wall-clock span (ctx manager)
+  inc(name, value=1.0)               counter add
+  gauge(name, value)                 gauge set
+  event(name, **attrs)               instant trace marker
+  heartbeat(name, every_s=30)        rate-limited structured progress logger
+  enabled() / configure(...)         state; YTK_TRACE / YTK_OBS env knobs
+  snapshot() / reset()               registry access
+  flush()                            write configured exports now
+  export_chrome_trace / export_jsonl / load_jsonl
+"""
+
+from .core import (  # noqa: F401
+    NOOP_SPAN,
+    REGISTRY,
+    Registry,
+    Span,
+    configure,
+    enabled,
+    event,
+    flush,
+    gauge,
+    inc,
+    record_collective,
+    reset,
+    snapshot,
+    span,
+)
+from .export import (  # noqa: F401
+    chrome_trace_events,
+    export_chrome_trace,
+    export_jsonl,
+    load_jsonl,
+)
+from .heartbeat import Heartbeat, heartbeat  # noqa: F401

@@ -1,0 +1,367 @@
+"""Tracing + metrics core — one process-wide registry for every layer.
+
+The reference scattered its run evidence across ad-hoc plumbing
+(`trainer.time_stats`, per-tree device fetches, stderr progress prints);
+this module replaces all of that with three primitives every layer shares:
+
+  spans     nested wall-clock intervals (`with span("tree.grow", tree=t):`)
+            with optional device-settled timing (`settle=` blocks on a jax
+            value before the end timestamp is taken)
+  counters  monotonically accumulated floats (`inc("ingest.rows", n)`)
+  gauges    last-write-wins floats (`gauge("gbdt.partition", 1)`)
+
+Everything lands in one `Registry`; the exporters (obs/export.py) turn it
+into a JSONL event stream and a Chrome-trace/Perfetto JSON file.
+
+Disabled-path contract (the < 1% tier-1 overhead budget): with obs off,
+`span()` is one module-global attribute load plus a cached no-op context
+manager, and `inc()`/`gauge()`/`event()` are one attribute load + return.
+No locks, no allocation beyond the kwargs dict at the call site. Tests
+pin this (tests/test_obs.py::test_disabled_path_is_noop).
+
+Env knobs (read once at import; `configure()` overrides at runtime):
+  YTK_TRACE=path        enable + write a Chrome-trace JSON at process exit
+  YTK_TRACE_JSONL=path  enable + write the JSONL event stream at exit
+  YTK_OBS=1             enable collection without any export
+  YTK_OBS=0             force-disable (wins over the path knobs)
+  YTK_OBS_JAX=1         also wrap spans in jax.profiler.TraceAnnotation so
+                        they show up inside XLA/xprof traces
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+# process-level clock origin: span timestamps are seconds since import on
+# the monotonic clock (Chrome trace wants relative µs; JSONL carries the
+# wall origin in its meta line so events can be re-anchored)
+_T0 = time.perf_counter()
+WALL_T0 = time.time()
+
+
+def _now() -> float:
+    return time.perf_counter() - _T0
+
+
+class Registry:
+    """Process-wide store for counters, gauges, and finished span events.
+
+    Span *stacks* are thread-local (nesting is a per-thread property);
+    counters/gauges/events are shared under one lock — contention is nil
+    because the hot paths touch the registry a handful of times per
+    tree/iteration, never per row.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counters: Dict[str, float] = {}
+        self.gauges: Dict[str, float] = {}
+        self.events: List[dict] = []
+        self._tls = threading.local()
+
+    def _stack(self) -> list:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def inc(self, name: str, value: float = 1.0) -> None:
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0.0) + value
+
+    def gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self.gauges[name] = float(value)
+
+    def add_event(self, ev: dict) -> None:
+        with self._lock:
+            self.events.append(ev)
+
+    def snapshot(self) -> dict:
+        """Point-in-time copy of counters + gauges (the bench/report
+        surface; events are export-only)."""
+        with self._lock:
+            return {
+                "counters": dict(self.counters),
+                "gauges": dict(self.gauges),
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self.counters.clear()
+            self.gauges.clear()
+            self.events.clear()
+
+
+REGISTRY = Registry()
+
+
+class _State:
+    __slots__ = ("enabled", "trace_path", "jsonl_path", "jax_annotations")
+
+    def __init__(self):
+        self.enabled = False
+        self.trace_path: Optional[str] = None
+        self.jsonl_path: Optional[str] = None
+        self.jax_annotations = False
+
+
+_state = _State()
+_UNSET = object()
+
+
+def enabled() -> bool:
+    return _state.enabled
+
+
+class _NoopSpan:
+    """Cached do-nothing context manager — the whole disabled span path."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def add(self, **kw):
+        return self
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+class Span:
+    """An open span; records one complete ("X") event on exit.
+
+    `settle` (array, pytree, or zero-arg callable returning one) is
+    block_until_ready'd before the end timestamp — opt-in device-settled
+    timing for spans that enqueue async device work.
+    """
+
+    __slots__ = ("name", "args", "t0", "_settle", "_jax_ann")
+
+    def __init__(self, name: str, args: dict, settle=None):
+        self.name = name
+        self.args = args
+        self._settle = settle
+        self._jax_ann = None
+
+    def add(self, **kw) -> "Span":
+        self.args.update(kw)
+        return self
+
+    def __enter__(self) -> "Span":
+        if _state.jax_annotations:
+            try:
+                import jax.profiler
+
+                self._jax_ann = jax.profiler.TraceAnnotation(self.name)
+                self._jax_ann.__enter__()
+            except Exception:  # noqa: BLE001 — annotation is best-effort
+                self._jax_ann = None
+        REGISTRY._stack().append(self.name)
+        self.t0 = _now()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if self._settle is not None:
+            try:
+                import jax
+
+                target = self._settle() if callable(self._settle) else self._settle
+                jax.block_until_ready(target)
+            except Exception:  # noqa: BLE001 — never let timing kill the run
+                pass
+        t1 = _now()
+        if self._jax_ann is not None:
+            try:
+                self._jax_ann.__exit__(exc_type, exc, tb)
+            except Exception:  # noqa: BLE001
+                pass
+        stack = REGISTRY._stack()
+        if stack:
+            stack.pop()
+        ev = {
+            "name": self.name,
+            "ph": "X",
+            "ts": self.t0,
+            "dur": t1 - self.t0,
+            "tid": threading.get_ident(),
+            "depth": len(stack),
+        }
+        if self.args:
+            ev["args"] = self.args
+        if exc_type is not None:
+            ev.setdefault("args", {})["error"] = exc_type.__name__
+        REGISTRY.add_event(ev)
+        return False
+
+
+def span(name: str, settle=None, **args):
+    """`with span("tree.grow", tree=t): ...` — no-op when obs is disabled.
+
+    `settle` is reserved: pass a jax value (or a callable producing one)
+    to block on it before the end timestamp (device-settled duration)."""
+    if not _state.enabled:
+        return NOOP_SPAN
+    return Span(name, args, settle)
+
+
+def inc(name: str, value: float = 1.0) -> None:
+    if not _state.enabled:
+        return
+    REGISTRY.inc(name, value)
+
+
+def gauge(name: str, value: float) -> None:
+    if not _state.enabled:
+        return
+    REGISTRY.gauge(name, value)
+
+
+def event(name: str, **args) -> None:
+    """Instant event (Chrome-trace "i" phase) — a point-in-time marker."""
+    if not _state.enabled:
+        return
+    ev = {
+        "name": name,
+        "ph": "i",
+        "ts": _now(),
+        "tid": threading.get_ident(),
+        "depth": len(REGISTRY._stack()),
+    }
+    if args:
+        ev["args"] = args
+    REGISTRY.add_event(ev)
+
+
+def snapshot() -> dict:
+    return REGISTRY.snapshot()
+
+
+def reset() -> None:
+    REGISTRY.reset()
+
+
+# ---------------------------------------------------------------------------
+# Collective-call recording (parallel/collectives.py hooks)
+# ---------------------------------------------------------------------------
+
+
+def _leaf_bytes(x) -> int:
+    """Static byte size of an array-like (works on jax tracers: shape and
+    dtype are trace-time facts) or a pytree of them."""
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is not None and dtype is not None:
+        try:
+            return int(math.prod(shape)) * int(dtype.itemsize)
+        except Exception:  # noqa: BLE001 — abstract dtypes without itemsize
+            return 0
+    if isinstance(x, dict):
+        return sum(_leaf_bytes(v) for v in x.values())
+    if isinstance(x, (tuple, list)):
+        return sum(_leaf_bytes(v) for v in x)
+    return 0
+
+
+def record_collective(verb: str, x, axis_name: str) -> None:
+    """Count a collective verb + its operand bytes and drop a zero-duration
+    span into the trace.
+
+    Called from the collectives module at *trace time* (inside jit
+    tracing), so counts are per-compilation, not per-execution — a static
+    census of the program's collective surface. That is exactly what you
+    want when debugging a hung multi-host collective ("which verbs, what
+    sizes, staged from where"); per-step collective wall time lives in the
+    XLA profile (YTK_OBS_JAX=1 / YTK_PROFILE_DIR)."""
+    if not _state.enabled:
+        return
+    nbytes = _leaf_bytes(x)
+    REGISTRY.inc(f"collectives.{verb}.calls", 1.0)
+    REGISTRY.inc(f"collectives.{verb}.bytes", float(nbytes))
+    REGISTRY.add_event(
+        {
+            "name": f"collectives.{verb}",
+            "ph": "X",
+            "ts": _now(),
+            "dur": 0.0,
+            "tid": threading.get_ident(),
+            "depth": len(REGISTRY._stack()),
+            "args": {"axis": axis_name, "bytes": nbytes, "traced": True},
+        }
+    )
+
+
+# ---------------------------------------------------------------------------
+# Configuration
+# ---------------------------------------------------------------------------
+
+_atexit_registered = False
+
+
+def _ensure_atexit() -> None:
+    global _atexit_registered
+    if _atexit_registered:
+        return
+    import atexit
+
+    atexit.register(flush)
+    _atexit_registered = True
+
+
+def flush() -> None:
+    """Write the configured exports now (also runs at process exit)."""
+    from .export import export_chrome_trace, export_jsonl
+
+    if _state.trace_path:
+        export_chrome_trace(_state.trace_path, REGISTRY)
+    if _state.jsonl_path:
+        export_jsonl(_state.jsonl_path, REGISTRY)
+
+
+def configure(
+    enabled: Optional[bool] = None,
+    trace_path=_UNSET,
+    jsonl_path=_UNSET,
+    jax_annotations: Optional[bool] = None,
+) -> None:
+    """Runtime configuration (the CLI's --trace-out lands here).
+
+    Setting a non-empty export path implies enabled=True unless `enabled`
+    is explicitly passed as False in the same call."""
+    if trace_path is not _UNSET:
+        _state.trace_path = trace_path or None
+        if trace_path and enabled is None:
+            enabled = True
+    if jsonl_path is not _UNSET:
+        _state.jsonl_path = jsonl_path or None
+        if jsonl_path and enabled is None:
+            enabled = True
+    if enabled is not None:
+        _state.enabled = bool(enabled)
+    if jax_annotations is not None:
+        _state.jax_annotations = bool(jax_annotations)
+    if _state.trace_path or _state.jsonl_path:
+        _ensure_atexit()
+
+
+def _configure_from_env() -> None:
+    flag = os.environ.get("YTK_OBS")
+    if flag == "0":  # force-off wins over everything
+        return
+    trace = os.environ.get("YTK_TRACE") or None
+    jsonl = os.environ.get("YTK_TRACE_JSONL") or None
+    if trace or jsonl or flag == "1":
+        configure(enabled=True, trace_path=trace, jsonl_path=jsonl)
+    if os.environ.get("YTK_OBS_JAX") == "1":
+        _state.jax_annotations = True
+
+
+_configure_from_env()

@@ -1,0 +1,55 @@
+"""Rate-limited structured progress logging — the replacement for bare
+`print(..., file=sys.stderr)` progress lines.
+
+A Heartbeat logs through the standard logging stack at most once per
+`every_s` seconds (the first beat always fires), and mirrors each emitted
+beat into the obs registry as an instant event + a beat counter when obs
+is enabled. Call `.beat(...)` as often as you like from a loop; the cost
+of a suppressed beat is one time.time() call.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Optional
+
+from . import core
+
+log = logging.getLogger("ytklearn_tpu.obs")
+
+
+class Heartbeat:
+    __slots__ = ("name", "every_s", "_last", "_log")
+
+    def __init__(
+        self,
+        name: str,
+        every_s: float = 30.0,
+        logger: Optional[logging.Logger] = None,
+    ):
+        self.name = name
+        self.every_s = float(every_s)
+        self._last = 0.0  # epoch 0 -> the first beat always fires
+        self._log = logger or log
+
+    def beat(self, msg: str = "", force: bool = False, **fields) -> bool:
+        """Emit one progress line (+ obs event) unless rate-limited.
+        Returns True when the beat fired."""
+        now = time.time()
+        if not force and (now - self._last) < self.every_s:
+            return False
+        self._last = now
+        text = msg
+        if fields:
+            kv = " ".join(f"{k}={v}" for k, v in fields.items())
+            text = f"{text} {kv}".strip()
+        self._log.info("[%s] %s", self.name, text)
+        if core.enabled():
+            core.REGISTRY.inc(f"heartbeat.{self.name}", 1.0)
+            core.event(f"heartbeat.{self.name}", msg=text)
+        return True
+
+
+def heartbeat(name: str, every_s: float = 30.0, logger=None) -> Heartbeat:
+    return Heartbeat(name, every_s=every_s, logger=logger)

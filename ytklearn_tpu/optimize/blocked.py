@@ -179,9 +179,11 @@ def mesh_chunked_value_and_grad(
     in_specs = (P(), tuple(P(axis) if r else P() for r in mask))
     out_specs = (P(), P())
 
+    from ..parallel.collectives import psum
+
     def local(w, batch):
         loss, grad = cvg(w, *batch)
-        return lax.psum(loss, axis), lax.psum(grad, axis)
+        return psum(loss, axis), psum(grad, axis)
 
     sm = shard_map(local, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
     return lambda w, *batch: sm(w, batch)
@@ -204,8 +206,10 @@ def mesh_chunked_sum(
     cs = chunked_sum(fn, chunk, mask, vary_axes=(axis,))
     in_specs = (P(), tuple(P(axis) if r else P() for r in mask))
 
+    from ..parallel.collectives import psum
+
     def local(w, batch):
-        return lax.psum(cs(w, *batch), axis)
+        return psum(cs(w, *batch), axis)
 
     sm = shard_map(local, mesh=mesh, in_specs=in_specs, out_specs=P())
     return lambda w, *batch: sm(w, batch)

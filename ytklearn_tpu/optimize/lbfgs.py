@@ -38,6 +38,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..obs import inc as obs_inc, span as obs_span
+
 _MODES = {"sufficient_decrease": 0, "wolfe": 1, "strong_wolfe": 2}
 
 
@@ -413,7 +415,9 @@ def minimize_lbfgs(
         n_batch=len(batch),
     )
 
-    pure, loss, g, wnorm, gnorm = first_eval(jnp.asarray(w0, dtype), reg, batch)
+    obs_inc("lbfgs.runs")
+    with obs_span("lbfgs.first_eval", dim=dim):
+        pure, loss, g, wnorm, gnorm = first_eval(jnp.asarray(w0, dtype), reg, batch)
     wnorm = max(float(wnorm), 1.0)
     state = LBFGSState(
         w=jnp.asarray(w0, dtype),
@@ -437,9 +441,18 @@ def minimize_lbfgs(
     status = "max_iter"
     converged = False
     for it in range(1, config.max_iter + 1):
-        state, wnorm, gnorm = iteration(state, reg, batch)
-        if int(state.ls_status) < 0:
-            status = f"line_search_failed({int(state.ls_status)})"
+        # the span's ls_status fetch doubles as the device sync the loop
+        # needs anyway — the duration is device-settled for free
+        with obs_span("lbfgs.iteration", it=it):
+            state, wnorm, gnorm = iteration(state, reg, batch)
+            ls = int(state.ls_status)
+        obs_inc("lbfgs.iterations")
+        if ls > 1:
+            # trials beyond the first = line-search retries (step rescales)
+            obs_inc("lbfgs.ls_retries", ls - 1)
+        if ls < 0:
+            obs_inc("lbfgs.ls_failures")
+            status = f"line_search_failed({ls})"
             break
         if callback is not None and callback(it, state):
             status = "callback_stop"

@@ -29,32 +29,50 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..obs import record_collective
 from .mesh import DATA_AXIS
+
+# Every verb below calls obs.record_collective before staging the XLA op:
+# when obs is enabled, each *traced* collective is counted (calls + operand
+# bytes per verb) and dropped into the trace as a zero-duration span — a
+# static census of the program's collective surface (per compilation, not
+# per execution; see record_collective's docstring).
 
 
 def psum(x, axis_name: str = DATA_AXIS):
+    record_collective("psum", x, axis_name)
     return lax.psum(x, axis_name)
 
 
 def pmax(x, axis_name: str = DATA_AXIS):
+    record_collective("pmax", x, axis_name)
     return lax.pmax(x, axis_name)
 
 
 def pmin(x, axis_name: str = DATA_AXIS):
+    record_collective("pmin", x, axis_name)
     return lax.pmin(x, axis_name)
 
 
-def psum_scatter(x, axis_name: str = DATA_AXIS, tiled: bool = True):
+def psum_scatter(
+    x, axis_name: str = DATA_AXIS, tiled: bool = True, scatter_dimension: int = 0
+):
     """reduceScatterArray equivalent: global sum, each rank keeps its slice.
 
     With tiled=True, input of shape (k*n_ranks, ...) returns (k, ...) — the
     same contiguous-slice ownership the reference's 2-D partition tables
-    express (CommUtils.createThreadArrayFroms/Tos)."""
-    return lax.psum_scatter(x, axis_name, tiled=tiled)
+    express (CommUtils.createThreadArrayFroms/Tos). scatter_dimension
+    picks the sliced axis (the GBDT engine scatters node histograms over
+    the feature axis, dimension 1)."""
+    record_collective("psum_scatter", x, axis_name)
+    return lax.psum_scatter(
+        x, axis_name, scatter_dimension=scatter_dimension, tiled=tiled
+    )
 
 
 def all_gather(x, axis_name: str = DATA_AXIS, tiled: bool = True):
     """allgatherArray equivalent: concatenate each rank's slice along dim 0."""
+    record_collective("all_gather", x, axis_name)
     return lax.all_gather(x, axis_name, tiled=tiled)
 
 
@@ -68,6 +86,7 @@ def pargmax_tuple(score, payload, axis_name: str = DATA_AXIS):
     score: scalar per rank; payload: pytree of scalars to carry along.
     Returns (best_score, best_payload) replicated on all ranks.
     """
+    record_collective("pargmax", (score, payload), axis_name)
     idx = lax.axis_index(axis_name)
     n = axis_size(axis_name)
     # NaN scores (split gains can be NaN from 0/0 hessian sums) are treated
@@ -136,7 +155,21 @@ def host_allgather_objects(obj):
     import numpy as np
     from jax.experimental import multihost_utils
 
+    from ..obs import inc as obs_inc, span as obs_span
+
     blob = np.frombuffer(pickle.dumps(obj), np.uint8)
+    obs_inc("collectives.host_allgather.calls", 1.0)
+    obs_inc("collectives.host_allgather.bytes", float(blob.size))
+    with obs_span("collectives.host_allgather", bytes=int(blob.size)):
+        return _host_allgather_blob(blob)
+
+
+def _host_allgather_blob(blob):
+    import pickle
+
+    import numpy as np
+    from jax.experimental import multihost_utils
+
     lens = np.asarray(
         multihost_utils.process_allgather(np.asarray([blob.size], np.int64))
     ).reshape(-1)

@@ -21,6 +21,7 @@ from ..config import hocon
 from ..eval import EvalSet
 from ..io.fs import FileSystem, LocalFileSystem, create_filesystem
 from ..io.reader import load_transform_hook
+from ..obs import heartbeat as obs_heartbeat, inc as obs_inc, span as obs_span
 
 log = logging.getLogger("ytklearn_tpu.predict")
 
@@ -221,31 +222,38 @@ def batch_predict_from_files(
             + xsplits[2] + delim.features_delim + extra
         )
 
+    hb = obs_heartbeat("predict.batch", every_s=30.0)
     for path in sorted(fs.recur_get_paths([file_dir])):
         staged: List[dict] = []
         with fs.open(path) as f:
             raw_lines: Iterable[str] = list(f)
-        for raw in raw_lines:
-            raw = raw.rstrip("\n")
-            if not raw.strip():
-                continue
-            for line in hook(raw.encode()) if hook is not None else [raw]:
-                try:
-                    staged.append(stage(line))
-                except _RowError as e:
-                    errors += 1
-                    if errors > max_error_tol:
-                        raise ValueError(
-                            f"max error tolerance exceeded ({errors}): {e}"
-                        ) from e
+        with obs_span("predict.score_file", file=path):
+            for raw in raw_lines:
+                raw = raw.rstrip("\n")
+                if not raw.strip():
+                    continue
+                for line in hook(raw.encode()) if hook is not None else [raw]:
+                    try:
+                        staged.append(stage(line))
+                    except _RowError as e:
+                        errors += 1
+                        if errors > max_error_tol:
+                            raise ValueError(
+                                f"max error tolerance exceeded ({errors}): {e}"
+                            ) from e
+        obs_inc("predict.rows", len(staged))
+        hb.beat(file=path, rows=len(staged), errors=errors)
 
         # batched activation: ONE jnp call per file
         vrows = [s for s in staged if "raw" in s]
         if vrows:
-            raws = np.stack([s["raw"] for s in vrows])  # (N, k)
-            k = raws.shape[1]
-            act = np.asarray(predictor.loss.predict(raws[:, 0] if k == 1 else raws))
-            act = act.reshape(len(vrows), -1)
+            with obs_span("predict.activate", rows=len(vrows)):
+                raws = np.stack([s["raw"] for s in vrows])  # (N, k)
+                k = raws.shape[1]
+                act = np.asarray(
+                    predictor.loss.predict(raws[:, 0] if k == 1 else raws)
+                )
+                act = act.reshape(len(vrows), -1)
             for s, arow in zip(vrows, act):
                 s["preds"] = [float(v) for v in arow]
 
@@ -281,4 +289,5 @@ def batch_predict_from_files(
         for k, v in eval_set.evaluate(preds, labels, weights).items():
             log.info("eval %s: %.6f", k, v)
 
+    obs_inc("predict.error_lines", errors)
     return total_loss / weight_cnt if weight_cnt > 0 else 0.0

@@ -27,6 +27,7 @@ from .eval import EvalSet
 from .io.fs import FileSystem, LocalFileSystem
 from .io.reader import DataIngest, IngestResult, SparseDataset
 from .models.linear import LinearModel
+from .obs import gauge as obs_gauge, inc as obs_inc, span as obs_span
 from .optimize import LBFGSConfig, inv_hessian_vp, minimize_lbfgs
 
 log = logging.getLogger("ytklearn_tpu.train")
@@ -135,7 +136,8 @@ class HoagTrainer:
         ts = self.time_stats = {}  # phase counters (data/gbdt/TimeStats.java
         # + TrainWorker.java:209-212 LoadDataFlow/PreprocessAndTrain segments)
         if ingest is None:
-            ingest = self._ingest()
+            with obs_span("train.load", model=self.model_name):
+                ingest = self._ingest()
         ts["load"] = time.time() - t0
         log.info(
             "load flow done in %.1fs: %d train rows, dim %d",
@@ -206,13 +208,14 @@ class HoagTrainer:
 
         def evaluate(w, results_sink: Dict) -> None:
             if eval_set is not None:
-                results_sink["train_metrics"] = eval_set.evaluate(
-                    jit_predicts(w, *train_b), train_b[-2], train_b[-1]
-                )
-                if test_b is not None:
-                    results_sink["test_metrics"] = eval_set.evaluate(
-                        jit_predicts(w, *test_b), test_b[-2], test_b[-1]
+                with obs_span("train.evaluate"):
+                    results_sink["train_metrics"] = eval_set.evaluate(
+                        jit_predicts(w, *train_b), train_b[-2], train_b[-1]
                     )
+                    if test_b is not None:
+                        results_sink["test_metrics"] = eval_set.evaluate(
+                            jit_predicts(w, *test_b), test_b[-2], test_b[-1]
+                        )
 
         # hyper-search (reference grid rounds :457-765 / HOAG :813-902) or
         # a single run
@@ -310,19 +313,21 @@ class HoagTrainer:
                     return True
                 return False
 
-            res = minimize_lbfgs(
-                model.pure_loss,
-                jnp.asarray(start_w, jnp.float32),
-                cfg,
-                batch=train_b,
-                l1_vec=l1_vec,
-                l2_vec=l2_vec,
-                g_weight=g_weight,
-                callback=callback,
-                row_chunk=row_chunk,
-                row_mask=row_mask,
-                mesh=self.mesh if row_chunk is not None else None,
-            )
+            obs_inc("train.rounds")
+            with obs_span("train.round", round=round_idx):
+                res = minimize_lbfgs(
+                    model.pure_loss,
+                    jnp.asarray(start_w, jnp.float32),
+                    cfg,
+                    batch=train_b,
+                    l1_vec=l1_vec,
+                    l2_vec=l2_vec,
+                    g_weight=g_weight,
+                    callback=callback,
+                    row_chunk=row_chunk,
+                    row_mask=row_mask,
+                    mesh=self.mesh if row_chunk is not None else None,
+                )
             carry_w = np.asarray(res.w)
             # round selection: test loss when available, else the *pure*
             # train loss — the regularized loss would always prefer the
@@ -415,6 +420,11 @@ class HoagTrainer:
         ts["train"] = time.time() - t0 - ts["load"]
         if res.n_iter > 0 and ts["train"] > 0:
             ts["iters_per_sec"] = res.n_iter / ts["train"]
+        # phase stats mirrored into the obs registry (one source of truth
+        # for bench/report surfaces; time_stats stays the in-process view)
+        for k, v in ts.items():
+            obs_gauge(f"train.phase.{k}", v)
+        obs_inc("train.iterations_total", res.n_iter)
         log.info(
             "training done: %s after %d iters, avg loss %.6f, metrics %s",
             res.status,
