@@ -60,8 +60,10 @@ log = logging.getLogger("ytklearn_tpu.bench")
 
 #: bench JSON schema: 1 = the flat pre-obs shape (BENCH_r01..r05), 2 adds
 #: schema_version + the obs snapshot block (counters/gauges incl. AOT
-#: downgrade events). scripts/ablate_engine.py::read_bench_record reads both.
-BENCH_SCHEMA_VERSION = 2
+#: downgrade events), 3 adds "health_events" (total health.* sentinel
+#: hits — the regression gate's third axis next to throughput and
+#: downgrades). scripts/ablate_engine.py::read_bench_record reads all.
+BENCH_SCHEMA_VERSION = 3
 
 # per-chip peaks for the achieved-vs-peak fields (dense MXU throughput /
 # HBM bandwidth; public spec-sheet numbers)
@@ -374,6 +376,10 @@ def main() -> None:
     # roofline then falls back to trainer.time_stats.
     if os.environ.get("YTK_OBS") != "0":
         obs.configure(enabled=True)
+        # run-health layer: flight ring for postmortems + compile counters
+        # feeding the retrace sentinel (docs/observability.md)
+        obs.recorder.auto_install()
+        obs.health.install_trace_counters()
     os.makedirs(".jax_cache", exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", os.path.abspath(".jax_cache"))
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
@@ -421,6 +427,9 @@ def main() -> None:
         "gauges": {k: round(v, 4) for k, v in sorted(snap["gauges"].items())},
     }
     out["downgrades"] = int(snap["counters"].get("gbdt.downgrade.total", 0))
+    # total sentinel hits; scripts/check_bench_regress.py fails the gate
+    # when this grows between comparable artifacts
+    out["health_events"] = obs.health.total_sentinel_hits(snap["counters"])
     print(json.dumps(out))
     if band_fail:
         sys.exit(1)

@@ -50,17 +50,31 @@ def _apply_env(cfg: str):
         os.environ[k] = v
 
 
+def _sentinel_hits(counters: dict) -> int:
+    """Root health.* total for pre-v3 artifacts — the ONE definition
+    lives in ytklearn_tpu.obs.health (bench.py writes with it; this
+    fallback must recompute identically or the gate compares skew)."""
+    from ytklearn_tpu.obs.health import total_sentinel_hits
+
+    return total_sentinel_hits(counters)
+
+
 def read_bench_record(path: str) -> dict:
     """Load a BENCH_*.json artifact, tolerating every schema generation:
-    v1 (BENCH_r01..r05 — flat fields, no schema_version) and v2+
-    (schema_version + the obs counters/gauges block). Returns a normalized
-    dict; absent fields come back as None/empty."""
+    v1 (BENCH_r01..r05 — flat fields, no schema_version), v2+
+    (schema_version + the obs counters/gauges block, v3 health_events),
+    and the CI driver wrapper ({"cmd", "rc", "tail", "parsed": <line>} —
+    the shape the checked-in BENCH_r*.json actually have). Returns a
+    normalized dict; absent fields come back as None/empty."""
     with open(path) as f:
         rec = json.load(f)
+    if "parsed" in rec and "cmd" in rec:  # CI driver wrapper
+        rec = rec["parsed"] or {}
     obs_block = rec.get("obs") or {}
     counters = obs_block.get("counters") or {}
     return {
         "schema_version": int(rec.get("schema_version", 1)),
+        "metric": rec.get("metric"),
         "trees_per_sec": rec.get("value"),
         "auc": rec.get("auc"),
         "logloss": rec.get("logloss"),
@@ -70,6 +84,7 @@ def read_bench_record(path: str) -> dict:
         "downgrades": rec.get(
             "downgrades", int(counters.get("gbdt.downgrade.total", 0))
         ),
+        "health_events": int(rec.get("health_events", _sentinel_hits(counters))),
         "obs": obs_block,
         "raw": rec,
     }

@@ -1,0 +1,134 @@
+"""Bench regression gate: compare the newest two BENCH_*.json artifacts.
+
+The checked-in BENCH_r*.json trajectory was archaeology — numbers you
+could read but nothing watched. This gate turns it into a signal: the
+newest comparable pair must not regress on
+
+  headline throughput   new value >= old * (1 - tol)   (tol default 15%)
+  downgrades            AOT compile-probe fallbacks must not increase
+  health events         sentinel hits (health.*) must not increase
+
+Comparable = both artifacts parse to a bench record (the CI driver
+wrapper's "parsed" block or a raw bench line) AND report the same
+"metric" — a linear-era artifact is never compared against a GBDT one.
+
+Exit 0 with a skip message when fewer than two comparable artifacts exist
+(fresh clones pass), exit 1 with the offending axis on regression.
+
+Usage: scripts/check_bench_regress.py [--dir REPO] [--tol 0.15]
+Wired into the verify recipe next to check_no_print.sh /
+check_suite_time.sh (ROADMAP.md).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import re
+import sys
+from typing import List, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from ablate_engine import read_bench_record  # noqa: E402
+
+
+def find_artifacts(repo: str) -> List[Tuple[int, str]]:
+    """[(round, path)] sorted by round number (BENCH_r<NN>.json)."""
+    out = []
+    for path in glob.glob(os.path.join(repo, "BENCH_*.json")):
+        m = re.search(r"BENCH_r?(\d+)\.json$", os.path.basename(path))
+        if m:
+            out.append((int(m.group(1)), path))
+    return sorted(out)
+
+
+def comparable_pair(artifacts: List[Tuple[int, str]]):
+    """Newest two records sharing a metric, or None. Unparseable / rc!=0
+    rounds (parsed: null) are skipped, not fatal."""
+    usable = []
+    for rnd, path in artifacts:
+        try:
+            rec = read_bench_record(path)
+        except Exception as e:  # noqa: BLE001 — a rotten artifact is a skip
+            print(f"  [skip] {os.path.basename(path)}: unreadable ({e})")
+            continue
+        if rec.get("metric") and rec.get("trees_per_sec") is not None:
+            usable.append((rnd, path, rec))
+        else:
+            print(f"  [skip] {os.path.basename(path)}: no parsed bench line")
+    if len(usable) < 2:
+        return None
+    newest = usable[-1]
+    for older in reversed(usable[:-1]):
+        if older[2]["metric"] == newest[2]["metric"]:
+            return older, newest
+    return None
+
+
+def check(old, new, tol: float) -> List[str]:
+    """-> list of failure messages (empty = gate passes)."""
+    (o_rnd, o_path, o), (n_rnd, n_path, n) = old, new
+    fails = []
+    floor = o["trees_per_sec"] * (1.0 - tol)
+    print(
+        f"  throughput: r{n_rnd} {n['trees_per_sec']:.3f} vs r{o_rnd} "
+        f"{o['trees_per_sec']:.3f} (floor {floor:.3f}, tol {tol:.0%})"
+    )
+    if n["trees_per_sec"] < floor:
+        fails.append(
+            f"throughput regressed: {n['trees_per_sec']:.3f} < "
+            f"{o['trees_per_sec']:.3f} * (1 - {tol}) = {floor:.3f}"
+        )
+    print(f"  downgrades: r{n_rnd} {n['downgrades']} vs r{o_rnd} {o['downgrades']}")
+    if n["downgrades"] > o["downgrades"]:
+        fails.append(
+            f"downgrades increased: {o['downgrades']} -> {n['downgrades']} "
+            "(a kernel rung was silently lost — see gbdt.downgrade.* in obs)"
+        )
+    print(
+        f"  health events: r{n_rnd} {n['health_events']} vs "
+        f"r{o_rnd} {o['health_events']}"
+    )
+    if n["health_events"] > o["health_events"]:
+        fails.append(
+            f"health sentinel hits increased: {o['health_events']} -> "
+            f"{n['health_events']} (see health.* counters / flight dump)"
+        )
+    return fails
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "--dir",
+        default=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        help="repo root holding BENCH_*.json (default: this repo)",
+    )
+    ap.add_argument(
+        "--tol",
+        type=float,
+        default=float(os.environ.get("BENCH_REGRESS_TOL", "0.15")),
+        help="allowed fractional throughput drop (default 0.15; "
+        "env BENCH_REGRESS_TOL)",
+    )
+    args = ap.parse_args(argv)
+
+    artifacts = find_artifacts(args.dir)
+    print(f"check_bench_regress: {len(artifacts)} BENCH artifact(s) in {args.dir}")
+    pair = comparable_pair(artifacts)
+    if pair is None:
+        print("check_bench_regress: SKIP (fewer than two comparable artifacts)")
+        return 0
+    fails = check(*pair, tol=args.tol)
+    if fails:
+        for f in fails:
+            print(f"FAIL: {f}", file=sys.stderr)
+        return 1
+    print("check_bench_regress: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

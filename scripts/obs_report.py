@@ -1,0 +1,249 @@
+"""Render a run-health report from any obs artifact.
+
+Accepts every evidence shape the stack produces and prints one
+human-readable postmortem: phases (top-level span wall time), health
+sentinel hits, AOT downgrades, memory watermarks, compile/retrace
+telemetry, and the collective census.
+
+    python scripts/obs_report.py flight_20260803-101512_4711_1.json
+    python scripts/obs_report.py /tmp/trace.json        # YTK_TRACE output
+    python scripts/obs_report.py /tmp/events.jsonl      # YTK_TRACE_JSONL
+    python scripts/obs_report.py BENCH_r05.json         # bench artifact
+
+Input kind is sniffed, not flagged:
+  flight dump   JSON object with a "flight" block (obs/recorder.py)
+  chrome trace  JSON object with "traceEvents" only (obs/export.py)
+  JSONL stream  first line is the {"type": "meta"} record
+  bench JSON    has "metric"/"value" (optionally under the CI driver
+                wrapper's "parsed")
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from collections import defaultdict
+from typing import Dict, List, Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _load(path: str) -> Tuple[str, dict]:
+    """-> (kind, {"events": [raw obs events], "counters": {}, "gauges": {},
+    "flight": {} | None, "bench": {} | None})"""
+    with open(path) as f:
+        first_line = f.readline()
+        f.seek(0)
+        try:
+            head = json.loads(first_line)
+        except json.JSONDecodeError:
+            head = None  # pretty-printed JSON spans lines: full-load below
+        if isinstance(head, dict) and head.get("type") == "meta":
+            from ytklearn_tpu.obs import load_jsonl
+
+            back = load_jsonl(path)
+            return "jsonl", {
+                "events": back["events"],
+                "counters": back["counters"],
+                "gauges": back["gauges"],
+                "flight": None,
+                "bench": None,
+            }
+        # single-line artifacts (everything json.dump writes) already
+        # parsed fully via the first line — don't parse the bytes twice
+        doc = head if isinstance(head, dict) else json.load(f)
+    if "flight" in doc:
+        fl = doc["flight"]
+        snap = fl.get("snapshot") or {}
+        return "flight", {
+            "events": fl.get("ring") or [],
+            "counters": snap.get("counters") or {},
+            "gauges": snap.get("gauges") or {},
+            "flight": fl,
+            "bench": None,
+        }
+    if "traceEvents" in doc:
+        events, counters = [], {}
+        for ev in doc["traceEvents"]:
+            if ev.get("ph") == "C":
+                counters[ev["name"]] = ev.get("args", {}).get("value", 0.0)
+            elif ev.get("ph") in ("X", "i"):
+                # chrome ts/dur are µs; raw obs events are seconds
+                events.append(
+                    {
+                        "name": ev["name"],
+                        "ph": ev["ph"],
+                        "ts": ev.get("ts", 0.0) / 1e6,
+                        "dur": ev.get("dur", 0.0) / 1e6,
+                        "depth": 0,
+                        "args": ev.get("args", {}),
+                    }
+                )
+        return "chrome-trace", {
+            "events": events,
+            "counters": counters,
+            "gauges": {},
+            "flight": None,
+            "bench": None,
+        }
+    rec = doc.get("parsed") if ("parsed" in doc and "cmd" in doc) else doc
+    rec = rec or {}
+    if "metric" in rec or "obs" in rec:
+        obs_block = rec.get("obs") or {}
+        return "bench", {
+            "events": [],
+            "counters": obs_block.get("counters") or {},
+            "gauges": obs_block.get("gauges") or {},
+            "flight": None,
+            "bench": rec,
+        }
+    raise SystemExit(f"unrecognized artifact shape: {path}")
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:.1f} {unit}"
+        n /= 1024
+    return f"{n:.1f} TiB"
+
+
+def _section(title: str) -> None:
+    print(f"\n-- {title} " + "-" * max(0, 58 - len(title)))
+
+
+def _phase_table(events: List[dict]) -> List[Tuple[str, float, int]]:
+    """Aggregate complete spans by name at the outermost recorded depth."""
+    spans = [e for e in events if e.get("ph") == "X"]
+    if not spans:
+        return []
+    top = min(e.get("depth", 0) for e in spans)
+    agg: Dict[str, List[float]] = defaultdict(list)
+    for e in spans:
+        if e.get("depth", 0) == top:
+            agg[e["name"]].append(e.get("dur", 0.0))
+    return sorted(
+        ((n, sum(ds), len(ds)) for n, ds in agg.items()),
+        key=lambda r: -r[1],
+    )
+
+
+def _prefixed(d: Dict[str, float], prefix: str) -> Dict[str, float]:
+    return {k: v for k, v in d.items() if k.startswith(prefix)}
+
+
+def report(path: str) -> None:
+    kind, data = _load(path)
+    counters, gauges, events = data["counters"], data["gauges"], data["events"]
+    print(f"== run-health report: {os.path.basename(path)} ({kind}) ==")
+
+    fl = data["flight"]
+    if fl:
+        print(f"reason: {fl.get('reason')}   wall_time: {fl.get('wall_time')}")
+        if fl.get("exception"):
+            print(f"exception: {fl['exception']}")
+        rt = fl.get("runtime") or {}
+        if rt:
+            print(
+                f"runtime: python {rt.get('python')} jax {rt.get('jax')} "
+                f"backend={rt.get('backend')} devices={rt.get('device_count')} "
+                f"pid={rt.get('pid')}"
+            )
+        fp = fl.get("config_fingerprint") or {}
+        if fp:
+            print(f"config: {fp.get('type')} sha1={str(fp.get('sha1'))[:12]}")
+        print(
+            f"ring: {len(events)} events (capacity {fl.get('ring_capacity')})"
+        )
+
+    bench = data["bench"]
+    if bench:
+        print(
+            f"metric: {bench.get('metric')} = {bench.get('value')} "
+            f"{bench.get('unit', '')}"
+        )
+        for k in ("auc", "logloss", "trees", "data_source", "quality_band"):
+            if k in bench:
+                print(f"  {k}: {bench[k]}")
+
+    phases = _phase_table(events)
+    if phases or _prefixed(gauges, "gbdt.stat."):
+        _section("phases")
+        for name, total, cnt in phases[:12]:
+            print(f"  {name:<28s} {total:10.3f} s  x{cnt}")
+        stat = _prefixed(gauges, "gbdt.stat.")
+        for k in ("load", "preprocess", "train", "finalize"):
+            v = stat.get(f"gbdt.stat.{k}")
+            if v is not None:
+                print(f"  gbdt.stat.{k:<18s} {v:10.3f} s")
+
+    health_c = _prefixed(counters, "health.")
+    health_ev = [e for e in events if e.get("name", "").startswith("health.")]
+    _section("health")
+    if not health_c and not health_ev:
+        print("  clean: no sentinel hits recorded")
+    for k, v in sorted(health_c.items()):
+        print(f"  {k:<40s} {v:g}")
+    for e in health_ev[-10:]:
+        print(f"  event {e['name']} @ {e.get('ts', 0):.3f}s {e.get('args', {})}")
+
+    downs = _prefixed(counters, "gbdt.downgrade.")
+    if downs:
+        _section("downgrades")
+        for k, v in sorted(downs.items()):
+            print(f"  {k:<40s} {v:g}")
+
+    mem = _prefixed(gauges, "mem.")
+    if mem:
+        _section("memory watermarks")
+        for k, v in sorted(mem.items()):
+            print(f"  {k:<40s} {_fmt_bytes(v)}")
+
+    comp = {
+        k: v
+        for k, v in counters.items()
+        if k.startswith("compile.")
+    }
+    if comp:
+        _section("compile telemetry")
+        for k, v in sorted(comp.items()):
+            unit = " s" if k.endswith("_secs") else ""
+            print(f"  {k:<40s} {v:g}{unit}")
+
+    coll: Dict[str, Dict[str, float]] = defaultdict(dict)
+    for k, v in counters.items():
+        if k.startswith("collectives."):
+            _, verb, what = k.split(".", 2)
+            coll[verb][what] = v
+    if coll:
+        _section("collective census (trace-time)")
+        for verb, d in sorted(coll.items()):
+            print(
+                f"  {verb:<16s} calls={d.get('calls', 0):g} "
+                f"bytes={_fmt_bytes(d.get('bytes', 0.0))}"
+            )
+
+    ingest = {
+        k: v
+        for k, v in counters.items()
+        if k.startswith(("ingest.", "lbfgs.", "gbdt.rounds", "gbdt.trees"))
+    }
+    if ingest:
+        _section("progress counters")
+        for k, v in sorted(ingest.items()):
+            print(f"  {k:<40s} {v:g}")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if not argv or argv[0] in ("-h", "--help"):
+        print(__doc__)
+        return 0 if argv else 2
+    for path in argv:
+        report(path)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -51,7 +51,9 @@ from ..obs import (
     enabled as obs_enabled,
     event as obs_event,
     gauge as obs_gauge,
+    health,
     inc as obs_inc,
+    recorder,
     span as obs_span,
 )
 from ..parallel.mesh import row_sharding
@@ -738,6 +740,11 @@ class GBDTTrainer:
             else None
         )
         self.sync_log: List[Tuple[int, float]] = []  # (round, wall s) at syncs
+        # retrace alarm: the round program is AOT-compiled, so any XLA
+        # compile counted after the FIRST sync (warmup: eval/predict jits)
+        # is an unexpected recompilation — a retrace storm shows up here
+        # instead of as silently-tripled round times
+        self._retrace = health.RetraceSentinel("gbdt.rounds")
         profile_dir = os.environ.get("YTK_PROFILE_DIR")
         if profile_dir:
             jax.profiler.start_trace(profile_dir)
@@ -794,14 +801,19 @@ class GBDTTrainer:
         p = self.params
         t0 = time.time()
         ts = self.time_stats = {}  # TimeStats equivalent (data/gbdt/TimeStats.java)
+        recorder.auto_install()
+        recorder.set_config_fingerprint(p)
+        health.install_trace_counters()
         if train is None:
             with obs_span("gbdt.load"):
                 train, test = GBDTIngest(p, self.fs).load()
         ts["load"] = time.time() - t0
+        health.record_memory("gbdt.load")
         K = self.K
 
         with obs_span("gbdt.preprocess", F=train.n_features):
             dd = self._prep_device_inputs(train, test)
+        health.record_memory("gbdt.preprocess")
         bins = dd.bins
         y, weight, y_t, w_t = dd.y, dd.weight, dd.y_t, dd.w_t
         ts["preprocess"] = time.time() - t0 - ts["load"]
@@ -844,6 +856,7 @@ class GBDTTrainer:
                 jit_round, carry, data, dd, model, train.feature_names,
                 start_round, has_test, t0, ts,
             )
+        health.record_memory("gbdt.train")
         scores, scores_t, bufs, loss_buf, tloss_buf = carry
         self.wave_log = np.asarray(jax.device_get(bufs["wlog"]))
         self._export_wave_stats(ts, dd, spec)
@@ -855,6 +868,7 @@ class GBDTTrainer:
                 trained_rounds=p.round_num,
             )
         ts["finalize"] = time.time() - t_fin
+        health.record_memory("gbdt.finalize")
         log.info(
             "[time stats] load=%.1fs preprocess=%.1fs train=%.1fs "
             "finalize=%.1fs%s",
@@ -872,6 +886,18 @@ class GBDTTrainer:
                 obs_gauge(f"gbdt.stat.{k}", float(v))
         return out
 
+    def _health_sync(self, rnd: int, tl: float) -> None:
+        """Sentinels at a pipeline sync: NaN/inf train loss (strict mode
+        aborts the run with the flight-dump path) and the unexpected-retrace
+        alarm — armed at the first sync, checked at every later one."""
+        if not health.enabled():
+            return
+        health.check_loss("gbdt.sync", tl, round=rnd)
+        if self._retrace.baseline is None:
+            self._retrace.arm()
+        else:
+            self._retrace.check(round=rnd)
+
     def _emit_sync(self, pending, t0) -> None:
         """Materialize a lagged sync record (round, loss slice[, test]).
         The logged time is the round's sync-point host timestamp carried in
@@ -882,6 +908,7 @@ class GBDTTrainer:
         obs_inc("gbdt.syncs")
         with obs_span("gbdt.sync", round=rnd, lagged=True):
             tl = float(loss_dev)  # completed a window ago: one RTT, no stall
+        self._health_sync(rnd, tl)
         elapsed = t_sync - t0
         self.sync_log.append((rnd, elapsed))
         msg = f"[round={rnd}] {elapsed:.1f}s train loss={tl:.6f}"
@@ -899,6 +926,7 @@ class GBDTTrainer:
         obs_inc("gbdt.syncs")
         with obs_span("gbdt.sync", round=rnd, lagged=False):
             tl = float(carry[3][rnd])  # syncs the pipeline
+        self._health_sync(rnd, tl)
         elapsed = time.time() - t0
         self.sync_log.append((rnd, elapsed))
         msg = f"[round={rnd}] {elapsed:.1f}s train loss={tl:.6f}"
@@ -965,11 +993,14 @@ class GBDTTrainer:
         # instead of 10 sequential fetches (D2H is ~115ms/transfer)
         host = jax.device_get({k: v[have:want] for k, v in bufs.items()})
         for i in range(want - have):
-            model.trees.append(
-                self._arrays_to_tree(
-                    {k: v[i] for k, v in host.items()}, bins, names
-                )
+            tree = self._arrays_to_tree(
+                {k: v[i] for k, v in host.items()}, bins, names
             )
+            # tree sanity on the already-fetched host arrays: an empty tree
+            # means boosting stopped learning; a NaN gain means the split
+            # statistics went rotten on device
+            health.check_tree("gbdt.tree", len(tree.gain), tree.gain, tree=have + i)
+            model.trees.append(tree)
 
     def _arrays_to_tree(self, d: Dict[str, np.ndarray], bins, names) -> Tree:
         nn = int(d["n_nodes"])

@@ -26,7 +26,12 @@ from typing import Callable, Dict, Iterable, Iterator, List, Optional, Sequence,
 import numpy as np
 
 from ..config.params import CommonParams, DelimParams
-from ..obs import heartbeat as obs_heartbeat, inc as obs_inc, span as obs_span
+from ..obs import (
+    health,
+    heartbeat as obs_heartbeat,
+    inc as obs_inc,
+    span as obs_span,
+)
 from .feature_hash import FeatureHash
 from .fs import FileSystem, LocalFileSystem
 
@@ -341,6 +346,7 @@ class DataIngest:
         ys = dict(self.params.data.y_sampling)
         rows: List[ParsedLine] = []
         errors = 0
+        subsampled = 0  # parse-valid lines dropped by y_sampling
         hb = obs_heartbeat("ingest.parse", every_s=30.0)
         for raw in lines:
             if len(rows) & 0xFFFF == 0 and rows:
@@ -369,6 +375,7 @@ class DataIngest:
                         if rate is not None:
                             pl.weight *= (1.0 / rate) if rate <= 1.0 else rate
                             if self.rng.random() > rate:
+                                subsampled += 1
                                 continue
                 except Exception:
                     errors += 1
@@ -378,6 +385,13 @@ class DataIngest:
                 rows.append(pl)
         obs_inc("ingest.rows_parsed", len(rows))
         obs_inc("ingest.error_lines", errors)
+        # rate sentinel under the absolute max_error_tol cap: a feed that is
+        # mostly garbage but below the cap should still raise a flag. The
+        # denominator counts parse-valid lines BEFORE y_sampling drops so
+        # heavy subsampling can't inflate the rate.
+        health.check_ingest(
+            "ingest.parse", errors, len(rows) + subsampled, is_train=is_train
+        )
         return rows
 
     # -- dict -----------------------------------------------------------
@@ -713,6 +727,7 @@ class DataIngest:
 
         keep = ~bad
         weight = blk.weights.astype(np.float64)
+        n_good = int(keep.sum())  # parse-valid lines, pre-subsample
         if is_train and p.data.y_sampling:
             # label-dependent subsampling with inverse-probability weight
             # correction (CoreData.yExtract). The host loop preserves the
@@ -729,6 +744,7 @@ class DataIngest:
                 # error line, like the python path's labels.index(1.0) raise
                 newly_bad = keep & ~has1
                 n_errors += int(newly_bad.sum())
+                n_good -= int(newly_bad.sum())
                 keep &= has1
             for i in np.flatnonzero(keep):
                 rate = ys.get(str(int(lidx[i])))
@@ -746,6 +762,11 @@ class DataIngest:
 
         obs_inc("ingest.rows_parsed", float(keep.sum()))
         obs_inc("ingest.error_lines", float(n_errors))
+        # rate over parse-valid lines BEFORE y_sampling drops: subsampling
+        # a 99%-discarded majority class must not inflate the error rate
+        health.check_ingest(
+            "ingest.parse_native", int(n_errors), n_good, is_train=is_train
+        )
         new_row = np.cumsum(keep) - 1
         occ_keep = keep[occ_row]
         return _Cols(

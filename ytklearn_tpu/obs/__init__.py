@@ -11,6 +11,16 @@ Public surface (see docs/observability.md):
   snapshot() / reset()               registry access
   flush()                            write configured exports now
   export_chrome_trace / export_jsonl / load_jsonl
+
+Run-health layer (obs/health.py, obs/recorder.py — docs/observability.md):
+
+  health                             NaN/divergence/ingest/tree sentinels,
+                                     mem.* + compile.traces.* telemetry;
+                                     YTK_HEALTH / YTK_HEALTH_STRICT knobs
+  recorder                           flight recorder: bounded event ring +
+                                     postmortem flight_<ts>.json dump on
+                                     abnormal exit; YTK_FLIGHT_* knobs
+  HealthError                        strict-mode sentinel escalation
 """
 
 from .core import (  # noqa: F401
@@ -36,3 +46,5 @@ from .export import (  # noqa: F401
     load_jsonl,
 )
 from .heartbeat import Heartbeat, heartbeat  # noqa: F401
+from . import health, recorder  # noqa: F401
+from .health import HealthError  # noqa: F401

@@ -61,6 +61,10 @@ class Registry:
         self.counters: Dict[str, float] = {}
         self.gauges: Dict[str, float] = {}
         self.events: List[dict] = []
+        # flight-recorder ring (obs/recorder.py): a bounded deque the
+        # recorder installs so the last N events survive for a postmortem
+        # dump even though `events` may be huge. None when not installed.
+        self.ring = None
         self._tls = threading.local()
 
     def _stack(self) -> list:
@@ -80,6 +84,8 @@ class Registry:
     def add_event(self, ev: dict) -> None:
         with self._lock:
             self.events.append(ev)
+            if self.ring is not None:
+                self.ring.append(ev)
 
     def snapshot(self) -> dict:
         """Point-in-time copy of counters + gauges (the bench/report
@@ -95,6 +101,8 @@ class Registry:
             self.counters.clear()
             self.gauges.clear()
             self.events.clear()
+            if self.ring is not None:
+                self.ring.clear()
 
 
 REGISTRY = Registry()

@@ -1,0 +1,378 @@
+"""Run-health sentinels + memory/recompilation telemetry.
+
+The r7 obs layer records evidence; this module *interprets* it at the few
+places the host already syncs with the device — so a diverging L-BFGS run,
+a NaN loss, an empty boosted tree, a retrace storm, or a rotten input file
+raises a flag (or, in strict mode, a `HealthError` carrying a flight-dump
+path) instead of finishing with garbage numbers.
+
+Sentinels (all fire `health.*` counters + an `obs.event`, and log):
+
+  check_loss(site, value)        NaN/inf detection on an already-fetched
+                                 host float; strict -> HealthError
+  ProgressGuard(site, window)    no-progress divergence: `window`
+                                 consecutive checks without relative
+                                 improvement fires `health.divergence`
+  check_ingest(site, errors, rows)  parse error-rate threshold
+                                 (YTK_HEALTH_INGEST_TOL, default 1%)
+  check_tree(site, n_nodes, gains)  empty-tree / NaN-gain detection on the
+                                 host-side tree conversion
+
+Telemetry:
+
+  record_memory(phase)           per-phase peak device memory
+                                 (device.memory_stats() where the backend
+                                 reports it; host RSS fallback) as `mem.*`
+                                 gauges
+  install_trace_counters()       jax.monitoring listeners -> `compile.traces.*`
+                                 counters (XLA backend compiles, jaxpr
+                                 traces, cache hits)
+  RetraceSentinel(site)          warmup-armed: a compile counted after
+                                 arm() fires `health.retrace` — the
+                                 unexpected-recompilation alarm for steady
+                                 loops
+
+Knobs:
+  YTK_HEALTH=0            opt out of every sentinel (checks become one
+                          attribute load + return — tier-1 contract)
+  YTK_HEALTH_STRICT=1     escalate sentinel hits to HealthError (message
+                          names the flight dump; read per-hit so tests and
+                          operators can flip it at runtime)
+  YTK_HEALTH_INGEST_TOL   ingest error-rate threshold (fraction, 0.01)
+
+Counters fire only while obs collection is enabled (`inc` is a no-op
+otherwise); detection itself — and strict escalation — work either way,
+so an un-instrumented production run still dies loudly instead of
+silently. Disabled-path contract pinned in tests/test_health.py.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import os
+from typing import Optional, Sequence
+
+from . import core, recorder
+
+log = logging.getLogger("ytklearn_tpu.obs.health")
+
+#: sites with fewer parsed lines than this never trip the ingest sentinel
+#: (a 10-line smoke file with one typo is not a pipeline regression)
+INGEST_MIN_LINES = 100
+
+
+class HealthError(RuntimeError):
+    """A sentinel hit under YTK_HEALTH_STRICT=1. `dump_path` names the
+    flight dump written at escalation time ("" when dumping failed)."""
+
+    def __init__(self, message: str, dump_path: str = ""):
+        super().__init__(message)
+        self.dump_path = dump_path
+
+
+class _HealthState:
+    __slots__ = ("on", "strict", "ingest_tol")
+
+    def __init__(self):
+        self.on = os.environ.get("YTK_HEALTH", "1") != "0"
+        self.strict: Optional[bool] = None  # None -> read env per hit
+        self.ingest_tol = float(os.environ.get("YTK_HEALTH_INGEST_TOL", "0.01"))
+
+
+_state = _HealthState()
+
+
+def enabled() -> bool:
+    return _state.on
+
+
+def configure_health(
+    on: Optional[bool] = None,
+    strict: Optional[bool] = None,
+    ingest_tol: Optional[float] = None,
+) -> None:
+    """Runtime override of the YTK_HEALTH* env knobs (tests; operators)."""
+    if on is not None:
+        _state.on = bool(on)
+    if strict is not None:
+        _state.strict = bool(strict)
+    if ingest_tol is not None:
+        _state.ingest_tol = float(ingest_tol)
+
+
+def _strict() -> bool:
+    if _state.strict is not None:
+        return _state.strict
+    return os.environ.get("YTK_HEALTH_STRICT") == "1"
+
+
+def _fire(kind: str, site: str, msg: str, escalate: bool = True, **args) -> None:
+    """Record one sentinel hit: `health.<kind>` counters + an instant obs
+    event + a warning log line; under strict (and `escalate`) dump the
+    flight ring and raise HealthError naming the dump."""
+    core.inc(f"health.{kind}")
+    core.inc(f"health.{kind}.{site}")
+    core.event(f"health.{kind}", site=site, **args)
+    log.warning("[health.%s] %s: %s", kind, site, msg)
+    if escalate and _strict():
+        path = recorder.dump(reason=f"health.{kind}:{site}")
+        raise HealthError(
+            f"health.{kind} at {site}: {msg} (flight dump: {path or 'unavailable'})",
+            dump_path=path,
+        )
+
+
+def check_loss(site: str, value: float, **args) -> bool:
+    """NaN/inf sentinel on an already-materialized loss. True = healthy."""
+    if not _state.on:
+        return True
+    if math.isfinite(value):
+        return True
+    _fire("nan", site, f"non-finite loss {value!r}", value=repr(value), **args)
+    return False
+
+
+class ProgressGuard:
+    """No-progress divergence detection over a sliding window of loss
+    fetches: `window` consecutive updates without `rel_tol` relative
+    improvement over the best-seen value fires `health.divergence` once
+    (then re-arms, so a long plateau fires once per window, not per step).
+
+    Observability-only by design: a plateau can be legitimate (the
+    optimizer's own convergence test is the stopping authority), so even
+    strict mode only flags it — escalation is reserved for NaN/inf.
+    """
+
+    __slots__ = ("site", "window", "rel_tol", "best", "stalled")
+
+    def __init__(self, site: str, window: int = 10, rel_tol: float = 1e-7):
+        self.site = site
+        self.window = window
+        self.rel_tol = rel_tol
+        self.best = math.inf
+        self.stalled = 0
+
+    def update(self, value: float, **args) -> bool:
+        """True = still making progress (or health off / not yet stalled)."""
+        if not _state.on:
+            return True
+        if not math.isfinite(value):
+            return True  # check_loss owns the NaN path
+        if self.best == math.inf or value < self.best - self.rel_tol * max(
+            abs(self.best), 1.0
+        ):
+            self.best = value
+            self.stalled = 0
+            return True
+        self.stalled += 1
+        if self.stalled < self.window:
+            return True
+        _fire(
+            "divergence",
+            self.site,
+            f"no loss improvement in {self.stalled} checks "
+            f"(best {self.best:.6g}, latest {value:.6g})",
+            escalate=False,
+            best=self.best,
+            latest=value,
+            stalled=self.stalled,
+            **args,
+        )
+        self.stalled = 0  # re-arm
+        return False
+
+
+def check_ingest(site: str, errors: int, rows: int, **args) -> bool:
+    """Parse error-rate sentinel. `max_error_tol` (an absolute count from
+    the reference config) stays the hard abort; this catches the *rate*
+    regression under it — a feed that is 5% garbage but below the absolute
+    cap. True = healthy."""
+    if not _state.on:
+        return True
+    total = errors + rows
+    if total < INGEST_MIN_LINES or errors == 0:
+        return True
+    rate = errors / total
+    if rate <= _state.ingest_tol:
+        return True
+    _fire(
+        "ingest_errors",
+        site,
+        f"{errors}/{total} lines bad ({100 * rate:.2f}% > "
+        f"{100 * _state.ingest_tol:.2f}% tolerance)",
+        errors=errors,
+        rows=rows,
+        rate=round(rate, 5),
+        **args,
+    )
+    return False
+
+
+def check_tree(site: str, n_nodes: int, gains: Sequence[float], **args) -> bool:
+    """Boosted-tree sanity on the host conversion: an empty tree (no
+    split found — the learner has stopped learning) or a NaN gain (the
+    split statistics went rotten upstream). True = healthy."""
+    if not _state.on:
+        return True
+    ok = True
+    if n_nodes <= 1:
+        # warning-level like divergence: boosting can legitimately
+        # saturate into stump trees (round_num oversized for the data) —
+        # escalating would abort mid-conversion and discard a valid model
+        _fire("empty_tree", site, "tree has no splits", escalate=False, **args)
+        ok = False
+    bad = [g for g in gains if not math.isfinite(g)]
+    if bad:
+        _fire(
+            "nan",
+            site,
+            f"{len(bad)} non-finite split gain(s)",
+            bad_gains=len(bad),
+            **args,
+        )
+        ok = False
+    return ok
+
+
+def total_sentinel_hits(counters) -> int:
+    """Sum the ROOT `health.<kind>` counters (the per-site
+    `health.<kind>.<site>` breakdown would double-count every hit). The
+    single definition bench.py and the regression gate's old-artifact
+    fallback both use — they must agree or the gate compares skewed
+    numbers."""
+    return int(
+        sum(
+            v
+            for k, v in counters.items()
+            if k.startswith("health.") and k.count(".") == 1
+        )
+    )
+
+
+# ---------------------------------------------------------------------------
+# Telemetry: memory watermarks + recompilation counters
+# ---------------------------------------------------------------------------
+
+
+def _host_rss_peak_bytes() -> Optional[float]:
+    try:
+        import resource
+
+        rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        # linux reports KiB, macOS bytes
+        return float(rss * 1024 if os.uname().sysname == "Linux" else rss)
+    except Exception:  # noqa: BLE001 — telemetry is best-effort
+        return None
+
+
+def record_memory(phase: str) -> None:
+    """Publish `mem.<phase>.*` gauges: per-device peak/in-use bytes where
+    the backend exposes memory_stats() (TPU/GPU), host peak RSS always.
+    One device query + two gauge writes — call at phase boundaries, never
+    per row/round."""
+    if not core.enabled():
+        return
+    try:
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:  # noqa: BLE001
+        stats = None
+    if stats:
+        peak = stats.get("peak_bytes_in_use")
+        in_use = stats.get("bytes_in_use")
+        if peak is not None:
+            core.gauge(f"mem.{phase}.device_peak_bytes", float(peak))
+            prev = core.REGISTRY.gauges.get("mem.device_peak_bytes", 0.0)
+            core.gauge("mem.device_peak_bytes", max(prev, float(peak)))
+        if in_use is not None:
+            core.gauge(f"mem.{phase}.device_bytes_in_use", float(in_use))
+    rss = _host_rss_peak_bytes()
+    if rss is not None:
+        core.gauge(f"mem.{phase}.host_rss_peak_bytes", rss)
+        core.gauge("mem.host_rss_peak_bytes", rss)
+
+
+_trace_counters_installed = False
+
+
+def install_trace_counters() -> None:
+    """Route jax.monitoring compile/trace events into `compile.traces.*`
+    counters (idempotent; listeners are process-global and cost one
+    enabled() check per event when obs is off).
+
+      compile.traces.backend_compile        XLA backend compiles
+      compile.traces.backend_compile_secs   cumulative seconds
+      compile.traces.jaxpr_trace            python->jaxpr traces
+      compile.traces.cache_hits             persistent-cache hits
+    """
+    global _trace_counters_installed
+    if _trace_counters_installed:
+        return
+    try:
+        import jax.monitoring as monitoring
+
+        def _on_duration(event: str, duration: float, **kw) -> None:
+            if not core.enabled():
+                return
+            if event.endswith("backend_compile_duration"):
+                core.inc("compile.traces.backend_compile")
+                core.inc("compile.traces.backend_compile_secs", duration)
+            elif event.endswith("jaxpr_trace_duration"):
+                core.inc("compile.traces.jaxpr_trace")
+
+        def _on_event(event: str, **kw) -> None:
+            if not core.enabled():
+                return
+            if "cache_hit" in event:
+                core.inc("compile.traces.cache_hits")
+
+        monitoring.register_event_duration_secs_listener(_on_duration)
+        monitoring.register_event_listener(_on_event)
+        _trace_counters_installed = True
+    except Exception as e:  # noqa: BLE001 — older jax without monitoring
+        log.debug("trace counters unavailable: %s", e)
+        _trace_counters_installed = True  # don't retry every call
+
+
+class RetraceSentinel:
+    """Unexpected-recompilation alarm for steady-state loops: arm() after
+    warmup (first sync), then every check() that sees the global
+    `compile.traces.backend_compile` counter above the armed baseline
+    fires `health.retrace` + `compile.retraces.unexpected` and re-baselines.
+    Needs install_trace_counters() + obs enabled (otherwise the counter
+    never moves and check() is a dict lookup)."""
+
+    __slots__ = ("site", "baseline")
+
+    def __init__(self, site: str):
+        self.site = site
+        self.baseline: Optional[float] = None
+
+    @staticmethod
+    def _compiles() -> float:
+        return core.REGISTRY.counters.get("compile.traces.backend_compile", 0.0)
+
+    def arm(self) -> None:
+        if _state.on:
+            self.baseline = self._compiles()
+
+    def check(self, **args) -> bool:
+        if not _state.on or self.baseline is None:
+            return True
+        cur = self._compiles()
+        if cur <= self.baseline:
+            return True
+        n = cur - self.baseline
+        self.baseline = cur
+        core.inc("compile.retraces.unexpected", n)
+        _fire(
+            "retrace",
+            self.site,
+            f"{n:.0f} unexpected XLA compile(s) after warmup",
+            escalate=False,
+            compiles=n,
+            **args,
+        )
+        return False

@@ -6,13 +6,21 @@ A Heartbeat logs through the standard logging stack at most once per
 beat into the obs registry as an instant event + a beat counter when obs
 is enabled. Call `.beat(...)` as often as you like from a loop; the cost
 of a suppressed beat is one time.time() call.
+
+Derived rates: for every numeric field, an emitted beat also reports the
+rate since the PREVIOUS emitted beat (`rows=512000` grows a
+`rows_per_s=17066.7`), so a 30 s ingest heartbeat reads as throughput,
+not as a cumulative count you must difference by hand. Rates are computed
+between fired beats only (suppressed beats don't reset the window), skip
+non-monotone fields (a counter that went down is re-baselined, not
+reported as a negative rate), and never appear on the first beat.
 """
 
 from __future__ import annotations
 
 import logging
 import time
-from typing import Optional
+from typing import Dict, Optional
 
 from . import core
 
@@ -20,7 +28,7 @@ log = logging.getLogger("ytklearn_tpu.obs")
 
 
 class Heartbeat:
-    __slots__ = ("name", "every_s", "_last", "_log")
+    __slots__ = ("name", "every_s", "_last", "_log", "_prev", "_prev_t")
 
     def __init__(
         self,
@@ -32,6 +40,23 @@ class Heartbeat:
         self.every_s = float(every_s)
         self._last = 0.0  # epoch 0 -> the first beat always fires
         self._log = logger or log
+        self._prev: Dict[str, float] = {}  # numeric fields at last fired beat
+        self._prev_t = 0.0
+
+    def _rates(self, now: float, fields: dict) -> Dict[str, float]:
+        dt = now - self._prev_t
+        rates: Dict[str, float] = {}
+        if self._prev and dt > 0:
+            for k, v in fields.items():
+                prev = self._prev.get(k)
+                if (
+                    prev is not None
+                    and isinstance(v, (int, float))
+                    and not isinstance(v, bool)
+                    and v >= prev
+                ):
+                    rates[f"{k}_per_s"] = round((v - prev) / dt, 1)
+        return rates
 
     def beat(self, msg: str = "", force: bool = False, **fields) -> bool:
         """Emit one progress line (+ obs event) unless rate-limited.
@@ -40,14 +65,23 @@ class Heartbeat:
         if not force and (now - self._last) < self.every_s:
             return False
         self._last = now
+        rates = self._rates(now, fields)
         text = msg
-        if fields:
-            kv = " ".join(f"{k}={v}" for k, v in fields.items())
+        shown = {**fields, **rates}
+        if shown:
+            kv = " ".join(f"{k}={v}" for k, v in shown.items())
             text = f"{text} {kv}".strip()
         self._log.info("[%s] %s", self.name, text)
         if core.enabled():
             core.REGISTRY.inc(f"heartbeat.{self.name}", 1.0)
-            core.event(f"heartbeat.{self.name}", msg=text)
+            core.event(f"heartbeat.{self.name}", msg=text, **rates)
+        # re-baseline on every fired beat (rates are beat-to-beat)
+        self._prev = {
+            k: float(v)
+            for k, v in fields.items()
+            if isinstance(v, (int, float)) and not isinstance(v, bool)
+        }
+        self._prev_t = now
         return True
 
 

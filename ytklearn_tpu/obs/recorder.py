@@ -1,0 +1,288 @@
+"""Flight recorder: a bounded ring of the last N obs events plus a crash
+dump, so an abnormal exit leaves a self-contained postmortem instead of a
+bare stack trace.
+
+`install()` puts a `collections.deque(maxlen=N)` ring on the registry
+(every span/event lands in it as it is recorded), then hooks the three
+abnormal-exit paths:
+
+  sys.excepthook   uncaught exception -> dump, then chain to the previous
+                   hook (the traceback still prints)
+  SIGTERM          dump, restore the previous handler, re-raise the signal
+                   (exit status is still the signal's)
+  atexit           dump only when an abnormal condition was flagged earlier
+                   (a clean exit writes nothing)
+
+`dump()` writes `flight_<ts>_<pid>.json` to `YTK_FLIGHT_DIR` (default cwd).
+The file is a valid Chrome-trace/Perfetto document — `traceEvents` holds
+the ring as complete "X"/"i" events plus counter samples, so
+https://ui.perfetto.dev opens it directly — with one extra `flight` block
+(reason, raw ring, registry snapshot, config fingerprint, jax/device and
+process info) that `scripts/obs_report.py` renders as a run-health report.
+
+Knobs:
+  YTK_FLIGHT_N=4096   ring capacity (events)
+  YTK_FLIGHT_DIR=.    dump directory
+  YTK_FLIGHT=0        disable auto_install() (trainers call it; explicit
+                      install() still works)
+
+Disabled-path contract: with obs collection off, spans/events never reach
+the registry, so the ring stays empty and `auto_install()` returns None
+after one enabled() check — the same attribute-load-only budget as the
+rest of the obs surface (pinned in tests/test_health.py).
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import logging
+import os
+import signal
+import sys
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+from . import core
+
+log = logging.getLogger("ytklearn_tpu.obs")
+
+FLIGHT_SCHEMA_VERSION = 1
+DEFAULT_RING_N = 4096
+
+
+class _RecState:
+    __slots__ = (
+        "installed",
+        "dir",
+        "prev_excepthook",
+        "prev_sigterm",
+        "abnormal",
+        "last_dump_path",
+        "config_fingerprint",
+        "dump_seq",
+    )
+
+    def __init__(self):
+        self.installed = False
+        self.dir: Optional[str] = None
+        self.prev_excepthook = None
+        self.prev_sigterm = None
+        self.abnormal = False
+        self.last_dump_path: Optional[str] = None
+        self.config_fingerprint: Optional[dict] = None
+        self.dump_seq = 0
+
+
+_state = _RecState()
+_install_lock = threading.Lock()
+
+
+def installed() -> bool:
+    return _state.installed
+
+
+def last_dump_path() -> Optional[str]:
+    return _state.last_dump_path
+
+
+def set_config_fingerprint(obj) -> None:
+    """Record a compact fingerprint of the run config for the dump —
+    a stable hash plus a short head of the repr (enough to tell two runs
+    apart without serializing a whole params tree)."""
+    import hashlib
+
+    try:
+        text = repr(obj)
+    except Exception:  # noqa: BLE001 — a broken repr must not kill training
+        text = f"<unrepresentable {type(obj).__name__}>"
+    _state.config_fingerprint = {
+        "type": type(obj).__name__,
+        "sha1": hashlib.sha1(text.encode("utf-8", "replace")).hexdigest(),
+        "head": text[:400],
+    }
+
+
+def _flight_dir() -> str:
+    return _state.dir or os.environ.get("YTK_FLIGHT_DIR") or os.getcwd()
+
+
+def _runtime_info() -> dict:
+    import platform
+
+    info = {
+        "pid": os.getpid(),
+        "argv": sys.argv,
+        "python": sys.version.split()[0],
+        "platform": platform.platform(),
+    }
+    # jax/device facts are best-effort: the dump must succeed even when the
+    # crash IS a broken jax runtime
+    try:
+        import jax
+
+        info["jax"] = jax.__version__
+        info["backend"] = jax.default_backend()
+        devs = jax.local_devices()
+        info["device_count"] = len(devs)
+        info["device_kind"] = devs[0].device_kind if devs else None
+    except Exception as e:  # noqa: BLE001
+        info["jax_error"] = f"{type(e).__name__}: {e}"[:200]
+    return info
+
+
+def dump(reason: str = "manual", exc: Optional[BaseException] = None) -> str:
+    """Write the flight dump now; returns the path. Always writes a fresh
+    file (timestamp + pid + sequence keyed), never raises — a failing dump
+    logs and returns "" rather than masking the original crash."""
+    try:
+        return _dump(reason, exc)
+    except Exception as e:  # noqa: BLE001 — the recorder must never be the crash
+        log.error("flight dump failed: %s: %s", type(e).__name__, e)
+        return ""
+
+
+def _dump(reason: str, exc: Optional[BaseException]) -> str:
+    from .export import chrome_trace_events
+
+    # timed acquire, not `with`: the SIGTERM handler runs on the main
+    # thread between bytecodes, so the signal can land while THIS thread
+    # already holds the (non-reentrant) registry lock inside add_event —
+    # a blocking acquire would deadlock a dying process. On timeout, copy
+    # without the lock: GIL-atomic enough for a best-effort postmortem.
+    locked = core.REGISTRY._lock.acquire(timeout=1.0)
+    try:
+        ring = list(core.REGISTRY.ring) if core.REGISTRY.ring is not None else []
+        counters = dict(core.REGISTRY.counters)
+        gauges = dict(core.REGISTRY.gauges)
+    finally:
+        if locked:
+            core.REGISTRY._lock.release()
+
+    # a throwaway registry holding only the ring -> reuse the exporter so
+    # the dump is Perfetto-loadable without duplicating the conversion
+    ring_reg = core.Registry()
+    ring_reg.events = ring
+    ring_reg.counters = counters
+    trace_events = chrome_trace_events(ring_reg)
+
+    flight = {
+        "schema_version": FLIGHT_SCHEMA_VERSION,
+        "reason": reason,
+        "wall_time": time.time(),
+        "wall_t0": core.WALL_T0,
+        "ring": ring,
+        "ring_capacity": (
+            core.REGISTRY.ring.maxlen if core.REGISTRY.ring is not None else 0
+        ),
+        "snapshot": {"counters": counters, "gauges": gauges},
+        "config_fingerprint": _state.config_fingerprint,
+        "runtime": _runtime_info(),
+    }
+    if exc is not None:
+        flight["exception"] = f"{type(exc).__name__}: {exc}"[:1000]
+
+    _state.dump_seq += 1
+    ts = time.strftime("%Y%m%d-%H%M%S")
+    name = f"flight_{ts}_{os.getpid()}_{_state.dump_seq}.json"
+    path = os.path.join(_flight_dir(), name)
+    doc = {
+        "traceEvents": trace_events,
+        "displayTimeUnit": "ms",
+        "otherData": {"producer": "ytklearn_tpu.obs.recorder"},
+        "flight": flight,
+    }
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, default=str)
+    os.replace(tmp, path)
+    _state.last_dump_path = path
+    log.warning("flight dump (%s) written to %s", reason, path)
+    return path
+
+
+def load_flight(path: str) -> dict:
+    """Parse a flight dump back into its `flight` block (+ traceEvents)."""
+    with open(path) as f:
+        doc = json.load(f)
+    out = dict(doc.get("flight") or {})
+    out["traceEvents"] = doc.get("traceEvents") or []
+    return out
+
+
+def _excepthook(exc_type, exc, tb):
+    _state.abnormal = True
+    dump("excepthook", exc)
+    prev = _state.prev_excepthook or sys.__excepthook__
+    prev(exc_type, exc, tb)
+
+
+def _sigterm_handler(signum, frame):
+    _state.abnormal = True
+    dump("sigterm")
+    # restore the EXACT previous disposition (SIG_IGN included — a wrapper
+    # that ignored SIGTERM must keep ignoring it after our dump), then
+    # re-raise so the exit status is still the signal's
+    prev = _state.prev_sigterm
+    signal.signal(
+        signal.SIGTERM, prev if prev is not None else signal.SIG_DFL
+    )
+    os.kill(os.getpid(), signal.SIGTERM)
+
+
+def _atexit_handler():
+    if _state.abnormal and _state.last_dump_path is None:
+        dump("atexit")
+
+
+def install(ring_n: Optional[int] = None, flight_dir: Optional[str] = None) -> None:
+    """Install the ring + abnormal-exit hooks (idempotent)."""
+    with _install_lock:
+        n = ring_n or int(os.environ.get("YTK_FLIGHT_N", DEFAULT_RING_N))
+        if flight_dir:
+            _state.dir = flight_dir
+        with core.REGISTRY._lock:
+            if core.REGISTRY.ring is None or core.REGISTRY.ring.maxlen != n:
+                core.REGISTRY.ring = deque(core.REGISTRY.events[-n:], maxlen=n)
+        if _state.installed:
+            return
+        _state.prev_excepthook = sys.excepthook
+        sys.excepthook = _excepthook
+        try:
+            _state.prev_sigterm = signal.signal(signal.SIGTERM, _sigterm_handler)
+        except ValueError:
+            _state.prev_sigterm = None  # non-main thread: excepthook/atexit only
+        atexit.register(_atexit_handler)
+        _state.installed = True
+
+
+def auto_install() -> None:
+    """Trainer entry hook: install when obs is collecting (YTK_FLIGHT=0
+    opts out). With obs disabled this is one enabled() check and a return —
+    the no-op contract call sites rely on."""
+    if not core.enabled():
+        return
+    if os.environ.get("YTK_FLIGHT") == "0":
+        return
+    install()
+
+
+def uninstall() -> None:
+    """Remove hooks + ring (test isolation; atexit stays registered but
+    becomes a no-op once the abnormal flag is cleared)."""
+    with _install_lock:
+        if _state.installed:
+            sys.excepthook = _state.prev_excepthook or sys.__excepthook__
+            if _state.prev_sigterm is not None:
+                try:
+                    signal.signal(signal.SIGTERM, _state.prev_sigterm)
+                except ValueError:
+                    pass
+            _state.installed = False
+        with core.REGISTRY._lock:
+            core.REGISTRY.ring = None
+        _state.abnormal = False
+        _state.last_dump_path = None
+        _state.config_fingerprint = None

@@ -38,7 +38,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..obs import inc as obs_inc, span as obs_span
+from ..obs import health, inc as obs_inc, span as obs_span
 
 _MODES = {"sufficient_decrease": 0, "wolfe": 1, "strong_wolfe": 2}
 
@@ -416,6 +416,9 @@ def minimize_lbfgs(
     )
 
     obs_inc("lbfgs.runs")
+    from ..obs import recorder
+
+    recorder.auto_install()  # flight ring for postmortems (no-op when obs off)
     with obs_span("lbfgs.first_eval", dim=dim):
         pure, loss, g, wnorm, gnorm = first_eval(jnp.asarray(w0, dtype), reg, batch)
     wnorm = max(float(wnorm), 1.0)
@@ -440,6 +443,11 @@ def minimize_lbfgs(
     it = 0
     status = "max_iter"
     converged = False
+    # health sentinels piggyback on the per-iteration ls_status sync: the
+    # loss is computed by then, so the fetch is a 4-byte RTT, not a stall.
+    # YTK_HEALTH=0 drops both the checks and the fetch (one attribute load).
+    health_on = health.enabled()
+    guard = health.ProgressGuard("lbfgs", window=10) if health_on else None
     for it in range(1, config.max_iter + 1):
         # the span's ls_status fetch doubles as the device sync the loop
         # needs anyway — the duration is device-settled for free
@@ -447,6 +455,14 @@ def minimize_lbfgs(
             state, wnorm, gnorm = iteration(state, reg, batch)
             ls = int(state.ls_status)
         obs_inc("lbfgs.iterations")
+        if health_on:
+            # outside the span so a strict escalation's flight dump carries
+            # the failing iteration's completed span in its ring
+            loss_val = float(state.loss)
+            if not health.check_loss("lbfgs.loss", loss_val, it=it):
+                status = "nan_loss"
+                break
+            guard.update(loss_val, it=it)
         if ls > 1:
             # trials beyond the first = line-search retries (step rescales)
             obs_inc("lbfgs.ls_retries", ls - 1)

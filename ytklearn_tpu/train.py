@@ -27,7 +27,13 @@ from .eval import EvalSet
 from .io.fs import FileSystem, LocalFileSystem
 from .io.reader import DataIngest, IngestResult, SparseDataset
 from .models.linear import LinearModel
-from .obs import gauge as obs_gauge, inc as obs_inc, span as obs_span
+from .obs import (
+    gauge as obs_gauge,
+    health,
+    inc as obs_inc,
+    recorder,
+    span as obs_span,
+)
 from .optimize import LBFGSConfig, inv_hessian_vp, minimize_lbfgs
 
 log = logging.getLogger("ytklearn_tpu.train")
@@ -135,10 +141,14 @@ class HoagTrainer:
         t0 = time.time()
         ts = self.time_stats = {}  # phase counters (data/gbdt/TimeStats.java
         # + TrainWorker.java:209-212 LoadDataFlow/PreprocessAndTrain segments)
+        recorder.auto_install()
+        recorder.set_config_fingerprint(p)
+        health.install_trace_counters()
         if ingest is None:
             with obs_span("train.load", model=self.model_name):
                 ingest = self._ingest()
         ts["load"] = time.time() - t0
+        health.record_memory("train.load")
         log.info(
             "load flow done in %.1fs: %d train rows, dim %d",
             ts["load"],
@@ -280,8 +290,14 @@ class HoagTrainer:
             l1, l2 = (hoag_l1, hoag_l2) if hoag_mode else rounds[round_idx]
             l1_vec, l2_vec = model.reg_vectors(l1, l2)
             start_w = w0 if p.hyper.restart else carry_w
+            # convex-loop sentinel on the TEST loss — the signal the
+            # lbfgs-internal sentinels can't see (they own the train loss;
+            # guarding both here would double-count every incident)
+            guard = health.ProgressGuard("train.convex_test", window=12)
 
-            def callback(it, state, _l1=l1, _l2=l2, _l1v=l1_vec, _l2v=l2_vec):
+            def callback(
+                it, state, _l1=l1, _l2=l2, _l1v=l1_vec, _l2v=l2_vec, _guard=guard
+            ):
                 rec = {
                     "iter": it,
                     "l1": _l1,
@@ -294,6 +310,9 @@ class HoagTrainer:
                     rec["test_loss"] = float(jit_loss(state.w, *test_b)) / max(
                         g_weight_test, 1e-12
                     )
+                if health.enabled() and "test_loss" in rec:
+                    health.check_loss("train.convex_test", rec["test_loss"], iter=it)
+                    _guard.update(rec["test_loss"], iter=it)
                 if it % 5 == 0 or it <= 1:
                     evaluate(state.w, rec)
                 history.append(rec)
@@ -418,6 +437,7 @@ class HoagTrainer:
         out.train_metrics = sink.get("train_metrics", {})
         out.test_metrics = sink.get("test_metrics", {})
         ts["train"] = time.time() - t0 - ts["load"]
+        health.record_memory("train.train")
         if res.n_iter > 0 and ts["train"] > 0:
             ts["iters_per_sec"] = res.n_iter / ts["train"]
         # phase stats mirrored into the obs registry (one source of truth
