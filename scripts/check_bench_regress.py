@@ -12,8 +12,18 @@ Comparable = both artifacts parse to a bench record (the CI driver
 wrapper's "parsed" block or a raw bench line) AND report the same
 "metric" — a linear-era artifact is never compared against a GBDT one.
 
+Serve gate: SERVE_r*.json artifacts (scripts/serve_bench.py --record,
+schema "serve_latency") are compared on the same-metric newest pair too,
+but on the latency axes that matter for serving:
+
+  sustained req/s       new >= old * (1 - tol)
+  p99 latency           new <= old * (1 + tol)   (the latency band)
+  retraces_after_warmup must stay 0
+
 Exit 0 with a skip message when fewer than two comparable artifacts exist
-(fresh clones pass), exit 1 with the offending axis on regression.
+(fresh clones pass — and so do clones that have only training BENCH
+artifacts and no serve ones), exit 1 with the offending axis on
+regression.
 
 Usage: scripts/check_bench_regress.py [--dir REPO] [--tol 0.15]
 Wired into the verify recipe next to check_no_print.sh /
@@ -99,6 +109,94 @@ def check(old, new, tol: float) -> List[str]:
     return fails
 
 
+# ---------------------------------------------------------------------------
+# Serve (latency-schema) artifacts
+# ---------------------------------------------------------------------------
+
+
+def find_serve_artifacts(repo: str) -> List[Tuple[int, str]]:
+    """[(round, path)] sorted by round number (SERVE_r<NN>.json)."""
+    out = []
+    for path in glob.glob(os.path.join(repo, "SERVE_*.json")):
+        m = re.search(r"SERVE_r?(\d+)\.json$", os.path.basename(path))
+        if m:
+            out.append((int(m.group(1)), path))
+    return sorted(out)
+
+
+def read_serve_record(path: str) -> dict:
+    """Normalize a serve_latency artifact (raw or CI-driver-wrapped)."""
+    import json
+
+    with open(path) as f:
+        rec = json.load(f)
+    if "parsed" in rec and "cmd" in rec:  # CI driver wrapper
+        rec = rec["parsed"] or {}
+    if rec.get("schema") != "serve_latency":
+        return {}
+    return {
+        "metric": rec.get("metric"),
+        "req_per_sec": rec.get("value"),
+        "p99_ms": rec.get("p99_ms"),
+        "retraces": rec.get("retraces_after_warmup"),
+        "raw": rec,
+    }
+
+
+def serve_comparable_pair(artifacts: List[Tuple[int, str]]):
+    usable = []
+    for rnd, path in artifacts:
+        try:
+            rec = read_serve_record(path)
+        except Exception as e:  # noqa: BLE001 — a rotten artifact is a skip
+            print(f"  [skip] {os.path.basename(path)}: unreadable ({e})")
+            continue
+        if rec.get("metric") and rec.get("req_per_sec") is not None:
+            usable.append((rnd, path, rec))
+        else:
+            print(f"  [skip] {os.path.basename(path)}: not a serve_latency record")
+    if len(usable) < 2:
+        return None
+    newest = usable[-1]
+    for older in reversed(usable[:-1]):
+        if older[2]["metric"] == newest[2]["metric"]:
+            return older, newest
+    return None
+
+
+def check_serve(old, new, tol: float) -> List[str]:
+    """-> failure messages for the serve (latency-schema) pair."""
+    (o_rnd, _o_path, o), (n_rnd, _n_path, n) = old, new
+    fails = []
+    floor = o["req_per_sec"] * (1.0 - tol)
+    print(
+        f"  serve req/s: r{n_rnd} {n['req_per_sec']:.1f} vs r{o_rnd} "
+        f"{o['req_per_sec']:.1f} (floor {floor:.1f}, tol {tol:.0%})"
+    )
+    if n["req_per_sec"] < floor:
+        fails.append(
+            f"serve throughput regressed: {n['req_per_sec']:.1f} < "
+            f"{o['req_per_sec']:.1f} * (1 - {tol}) = {floor:.1f}"
+        )
+    if o.get("p99_ms") is not None and n.get("p99_ms") is not None:
+        ceil = o["p99_ms"] * (1.0 + tol)
+        print(
+            f"  serve p99: r{n_rnd} {n['p99_ms']:.3f} ms vs r{o_rnd} "
+            f"{o['p99_ms']:.3f} ms (ceiling {ceil:.3f})"
+        )
+        if n["p99_ms"] > ceil:
+            fails.append(
+                f"serve p99 latency regressed: {n['p99_ms']:.3f} ms > "
+                f"{o['p99_ms']:.3f} * (1 + {tol}) = {ceil:.3f} ms"
+            )
+    if n.get("retraces"):
+        fails.append(
+            f"serve steady-state retraces: {n['retraces']} "
+            "(the shape ladder is leaking shapes — see health.retrace)"
+        )
+    return fails
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument(
@@ -118,10 +216,22 @@ def main(argv=None) -> int:
     artifacts = find_artifacts(args.dir)
     print(f"check_bench_regress: {len(artifacts)} BENCH artifact(s) in {args.dir}")
     pair = comparable_pair(artifacts)
+    fails: List[str] = []
     if pair is None:
-        print("check_bench_regress: SKIP (fewer than two comparable artifacts)")
-        return 0
-    fails = check(*pair, tol=args.tol)
+        print("check_bench_regress: SKIP train gate (fewer than two "
+              "comparable artifacts)")
+    else:
+        fails += check(*pair, tol=args.tol)
+
+    serve_artifacts = find_serve_artifacts(args.dir)
+    print(f"check_bench_regress: {len(serve_artifacts)} SERVE artifact(s)")
+    serve_pair = serve_comparable_pair(serve_artifacts)
+    if serve_pair is None:
+        print("check_bench_regress: SKIP serve gate (fewer than two "
+              "comparable artifacts)")
+    else:
+        fails += check_serve(*serve_pair, tol=args.tol)
+
     if fails:
         for f in fails:
             print(f"FAIL: {f}", file=sys.stderr)

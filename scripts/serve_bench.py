@@ -1,0 +1,291 @@
+"""Serving bench: compiled micro-batched scorer vs the per-request loop.
+
+Measures, on one process:
+
+  baseline   `predictor.score(row)` per request (the reference
+             OnlinePredictor serving pattern): host hash-map tree walks
+  serve      CompiledScorer behind a MicroBatcher, driven by a bounded
+             in-flight window of single-row requests — the production
+             /predict hot path minus HTTP framing
+
+and reports sustained req/s for both, per-request latency p50/p99 (queue
+wait included), the bit-identity check against `batch_scores`, and the
+post-warmup retrace count across a mixed-request-size sweep (must be 0 —
+the shape ladder's whole job).
+
+Model: the agaricus GBDT demo (trained on the spot) when /root/reference
+is present, else a synthetic ensemble in the same format. Emits one
+BENCH-style JSON line (schema "serve_latency"); --record also writes it to
+a file for scripts/check_bench_regress.py's serve gate (SERVE_rNN.json).
+
+Acceptance (ISSUE 4): speedup >= SERVE_BENCH_MIN_SPEEDUP (default 10) and
+scores bit-identical and no steady-state retrace — failures exit non-zero
+AFTER the JSON line is printed (the bench.py artifact discipline).
+
+Usage: python scripts/serve_bench.py [--seconds 2.0] [--record SERVE_rNN.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import json
+import logging
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_ENABLE_X64", "1")  # bit-identity needs f64
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+REF = "/root/reference"
+
+
+def _build_model(tmp_dir: str):
+    """-> (predictor, feature names, request generator, source tag)."""
+    from ytklearn_tpu.predict import create_predictor
+
+    if os.path.exists(f"{REF}/demo/data/libsvm/agaricus.train.libsvm"):
+        from ytklearn_tpu.cli import convert_main, train_main
+
+        train_ytk = os.path.join(tmp_dir, "agaricus.ytk")
+        convert_main([
+            "binary_classification@0,1",
+            f"{REF}/demo/data/libsvm/agaricus.train.libsvm",
+            train_ytk,
+        ])
+        model_path = os.path.join(tmp_dir, "gbdt.model")
+        trees = int(os.environ.get("SERVE_BENCH_TREES", "500"))
+        depth = int(os.environ.get("SERVE_BENCH_DEPTH", "6"))
+        rc = train_main([
+            "gbdt",
+            f"{REF}/demo/gbdt/binary_classification/local_gbdt.conf",
+            "--set", f"data.train.data_path={train_ytk}",
+            "--set", "data.test.data_path=",
+            "--set", f"model.data_path={model_path}",
+            "--set", f"model.feature_importance_path={tmp_dir}/gbdt.fimp",
+            "--set", "data.max_feature_dim=127",
+            "--set", f"optimization.round_num={trees}",
+            "--set", f"optimization.max_depth={depth}",
+            "--set", "optimization.watch_train=false",
+            "--set", "optimization.watch_test=false",
+        ])
+        if rc != 0:
+            raise RuntimeError("agaricus gbdt training failed")
+        # round_num defaults to 50 and caps use_rounds — without it the
+        # predictor would silently serve only the first 50 trees
+        cfg = {"model": {"data_path": model_path},
+               "optimization": {"loss_function": "sigmoid",
+                                "round_num": trees}}
+        pred = create_predictor("gbdt", cfg)
+        names = sorted(
+            {nm for t in pred.model.trees
+             for i, nm in enumerate(t.feat_name) if not t.is_leaf(i)}
+        )
+        # agaricus requests: one-hot-ish sparse rows over the tree features
+        def gen_rows(rng, n):
+            return [
+                {nm: 1.0 for nm in rng.choice(names, size=22, replace=False)}
+                for _ in range(n)
+            ]
+
+        return pred, names, gen_rows, "agaricus"
+
+    # bare container: synthetic ensemble in the reference dump format
+    from ytklearn_tpu.gbdt.tree import GBDTModel, Tree
+
+    rng = np.random.RandomState(0)
+    names = [f"c{i}" for i in range(30)]
+
+    def rand_tree(depth):
+        t = Tree()
+
+        def grow(nid, d):
+            if d >= depth:
+                t.leaf_value[nid] = float(rng.randn() * 0.3)
+                return
+            t.feat[nid] = 0
+            t.feat_name[nid] = str(names[rng.randint(len(names))])
+            t.split[nid] = float(rng.randn() * 0.5)
+            t.default_left[nid] = bool(rng.rand() < 0.5)
+            left, right = t.add_children(nid)
+            grow(left, d + 1)
+            grow(right, d + 1)
+
+        grow(0, 0)
+        return t
+
+    trees = int(os.environ.get("SERVE_BENCH_TREES", "500"))
+    depth = int(os.environ.get("SERVE_BENCH_DEPTH", "6"))
+    model = GBDTModel(base_prediction=0.5, num_tree_in_group=1,
+                      obj_name="sigmoid",
+                      trees=[rand_tree(depth) for _ in range(trees)])
+    model_path = os.path.join(tmp_dir, "gbdt.model")
+    with open(model_path, "w") as f:
+        f.write(model.dumps())
+    cfg = {"model": {"data_path": model_path},
+           "optimization": {"loss_function": "sigmoid",
+                            "round_num": trees}}
+    pred = create_predictor("gbdt", cfg)
+
+    def gen_rows(rng, n):
+        return [
+            {nm: float(rng.randn()) for nm in names if rng.rand() > 0.3}
+            for _ in range(n)
+        ]
+
+    return pred, names, gen_rows, "synthetic"
+
+
+def bench_baseline(pred, rows, seconds: float) -> float:
+    """Per-request score() loop -> req/s."""
+    n, i, t0 = 0, 0, time.perf_counter()
+    end = t0 + seconds
+    while time.perf_counter() < end:
+        pred.score(rows[i % len(rows)])
+        i += 1
+        n += 1
+    return n / (time.perf_counter() - t0)
+
+
+def bench_serve(scorer, rows, seconds: float, window: int = 512):
+    """Bounded-in-flight single-row driver through the MicroBatcher ->
+    (req/s, latency list ms)."""
+    from ytklearn_tpu.serve import BatchPolicy, MicroBatcher
+
+    batcher = MicroBatcher(
+        scorer.score_and_predict,
+        BatchPolicy(max_batch=scorer.ladder[-1], max_wait_ms=1.0,
+                    max_queue=window * 4),
+    )
+    latencies = []
+    inflight = collections.deque()
+    n, i = 0, 0
+    t0 = time.perf_counter()
+    end = t0 + seconds
+    try:
+        while True:
+            now = time.perf_counter()
+            if now >= end and not inflight:
+                break
+            if now < end and len(inflight) < window:
+                inflight.append((batcher.submit([rows[i % len(rows)]]),
+                                 time.perf_counter()))
+                i += 1
+                continue
+            pending, t_sub = inflight.popleft()
+            pending.get(timeout=30.0)
+            latencies.append((time.perf_counter() - t_sub) * 1e3)
+            n += 1
+    finally:
+        batcher.close(drain=True)
+    return n / (time.perf_counter() - t0), latencies
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--seconds", type=float,
+                    default=float(os.environ.get("SERVE_BENCH_SECONDS", "2.0")))
+    ap.add_argument("--requests", type=int, default=2048,
+                    help="distinct request rows cycled through")
+    ap.add_argument("--record", default="",
+                    help="also write the JSON artifact here (SERVE_rNN.json)")
+    args = ap.parse_args()
+
+    logging.basicConfig(level=logging.INFO, stream=sys.stderr,
+                        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    log = logging.getLogger("serve_bench")
+
+    import jax
+
+    from ytklearn_tpu import obs
+    from ytklearn_tpu.obs import health
+    from ytklearn_tpu.serve import CompiledScorer
+
+    if os.environ.get("YTK_OBS") != "0":
+        obs.configure(enabled=True)
+        health.install_trace_counters()
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as tmp_dir:
+        pred, _names, gen_rows, source = _build_model(tmp_dir)
+        rng = np.random.RandomState(7)
+        rows = gen_rows(rng, args.requests)
+
+        scorer = CompiledScorer(pred)  # warms the full ladder
+        log.info("model=%s trees=%d ladder=%s dim=%d", source,
+                 len(pred.model.trees), scorer.ladder, scorer.dim)
+
+        # correctness first: the compiled path must reproduce batch_scores
+        sample = rows[:512]
+        got = scorer.score_batch(sample)
+        want = pred.batch_scores(sample)
+        x64 = bool(jax.config.jax_enable_x64)
+        bit_identical = bool(np.array_equal(got, want))
+        if not x64:
+            # f32 backends (TPU without x64) cannot be bit-exact; hold the
+            # line at float32 round-off instead
+            np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+        baseline_qps = bench_baseline(pred, rows, args.seconds)
+        log.info("baseline score() loop: %.0f req/s", baseline_qps)
+
+        compiles_before = obs.REGISTRY.counters.get(
+            "compile.traces.backend_compile", 0.0)
+        serve_qps, latencies = bench_serve(scorer, rows, args.seconds)
+        # mixed request sizes straight into the scorer: the ladder must
+        # absorb every shape without a new XLA compile
+        for size in (1, 2, 3, 5, 7, 8, 13, 64, 65, 200, 512, 700):
+            scorer.score_batch(gen_rows(rng, size))
+        retraces = obs.REGISTRY.counters.get(
+            "compile.traces.backend_compile", 0.0) - compiles_before
+
+        lat = np.asarray(latencies) if latencies else np.asarray([0.0])
+        speedup = serve_qps / baseline_qps if baseline_qps > 0 else 0.0
+        snap = obs.snapshot()
+        out = {
+            "schema_version": 1,
+            "schema": "serve_latency",
+            "metric": f"serve_req_per_sec_{source}_gbdt",
+            "value": round(serve_qps, 1),
+            "unit": "req/s",
+            "baseline_req_per_sec": round(baseline_qps, 1),
+            "speedup_vs_score_loop": round(speedup, 2),
+            "p50_ms": round(float(np.percentile(lat, 50)), 3),
+            "p99_ms": round(float(np.percentile(lat, 99)), 3),
+            "requests": len(latencies),
+            "bit_identical": bit_identical,
+            "x64": x64,
+            "retraces_after_warmup": int(retraces),
+            "ladder": list(scorer.ladder),
+            "data_source": source,
+            "obs": {
+                "counters": {k: round(v, 3)
+                             for k, v in sorted(snap["counters"].items())
+                             if k.startswith(("serve.", "compile.", "health."))},
+            },
+        }
+        print(json.dumps(out), flush=True)
+        if args.record:
+            with open(args.record, "w") as f:
+                json.dump(out, f, indent=1)
+
+        min_speedup = float(os.environ.get("SERVE_BENCH_MIN_SPEEDUP", "10"))
+        fails = []
+        if speedup < min_speedup:
+            fails.append(f"speedup {speedup:.2f}x < {min_speedup}x")
+        if x64 and not bit_identical:
+            fails.append("serve scores not bit-identical to batch_scores")
+        if retraces > 0:
+            fails.append(f"{retraces:.0f} steady-state retrace(s) after warmup")
+        for msg in fails:
+            log.error("FAIL: %s", msg)
+        return 1 if fails else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,169 @@
+"""Synthetic model fixtures for the serve/predict hot-path tests.
+
+Unlike tests/test_predict.py (which replays /root/reference demo data and
+skips without it), these builders hand-write small model text files in the
+reference dump formats, so the serving layer stays tier-1-testable on a
+bare container. Shapes are small but non-trivial (multi-level trees, all
+gate variants) to exercise every lowering path in serve/scorer.py.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ytklearn_tpu.gbdt.tree import GBDTModel, Tree
+from ytklearn_tpu.predict import create_predictor
+
+FEATS = [f"c{i}" for i in range(6)]
+
+
+def request_rows(n, rng, names=FEATS, p_missing=0.3, extra_unknown=True):
+    """Feature-dict rows with random gaps + the odd unknown feature."""
+    rows = []
+    for _ in range(n):
+        fmap = {
+            nm: float(rng.randn())
+            for nm in names
+            if rng.rand() > p_missing
+        }
+        if extra_unknown and rng.rand() < 0.2:
+            fmap["unknown_feat"] = 1.0
+        rows.append(fmap)
+    return rows
+
+
+def build_linear(tmp_path, seed=0, n=8):
+    rng = np.random.RandomState(seed)
+    names = [f"c{i}" for i in range(n)]
+    path = tmp_path / "linear.model"
+    lines = [f"{nm},{rng.randn():.6f},{abs(rng.randn()) + 1.0:.6f}" for nm in names]
+    lines.append(f"_bias_,{rng.randn():.6f}")
+    path.write_text("\n".join(lines) + "\n")
+    cfg = {"model": {"data_path": str(path)}, "loss": {"loss_function": "sigmoid"}}
+    return create_predictor("linear", cfg), names
+
+
+def build_multiclass(tmp_path, seed=1, n=8, K=4):
+    rng = np.random.RandomState(seed)
+    names = [f"c{i}" for i in range(n)]
+    path = tmp_path / "mc.model"
+    lines = [
+        nm + "," + ",".join(f"{v:.6f}" for v in rng.randn(K - 1)) for nm in names
+    ]
+    lines.append("_bias_," + ",".join(f"{v:.6f}" for v in rng.randn(K - 1)))
+    path.write_text("\n".join(lines) + "\n")
+    cfg = {
+        "model": {"data_path": str(path)},
+        "loss": {"loss_function": "softmax"},
+        "k": K,
+    }
+    return create_predictor("multiclass_linear", cfg), names
+
+
+def build_fm(tmp_path, seed=2, n=8, k=4):
+    rng = np.random.RandomState(seed)
+    names = [f"c{i}" for i in range(n)]
+    path = tmp_path / "fm.model"
+    lines = [
+        nm + "," + ",".join(f"{v:.6f}" for v in rng.randn(1 + k)) for nm in names
+    ]
+    lines.append("_bias_," + ",".join(f"{v:.6f}" for v in rng.randn(1 + k)))
+    path.write_text("\n".join(lines) + "\n")
+    cfg = {
+        "model": {"data_path": str(path)},
+        "loss": {"loss_function": "sigmoid"},
+        "k": [1, k],
+    }
+    return create_predictor("fm", cfg), names
+
+
+def build_ffm(tmp_path, seed=3, n_fields=3, per_field=3, k=3):
+    rng = np.random.RandomState(seed)
+    fields = [f"fld{i}" for i in range(n_fields)]
+    names = [f"{f}@x{j}" for f in fields for j in range(per_field)]
+    fd = tmp_path / "field.dict"
+    fd.write_text("\n".join(fields) + "\n")
+    path = tmp_path / "ffm.model"
+    stride = n_fields * k
+    lines = [
+        nm + "," + ",".join(f"{v:.6f}" for v in rng.randn(1 + stride))
+        for nm in names
+    ]
+    lines.append("_bias_," + ",".join(f"{v:.6f}" for v in rng.randn(1 + stride)))
+    path.write_text("\n".join(lines) + "\n")
+    cfg = {
+        "model": {"data_path": str(path), "field_dict_path": str(fd)},
+        "loss": {"loss_function": "sigmoid"},
+        "k": [1, k],
+    }
+    return create_predictor("ffm", cfg), names
+
+
+def _rand_tree(rng, names, depth):
+    t = Tree()
+
+    def grow(nid, d):
+        if d >= depth:
+            t.leaf_value[nid] = float(rng.randn() * 0.3)
+            return
+        t.feat[nid] = 0  # >= 0 marks an inner node; serving keys on feat_name
+        t.feat_name[nid] = str(names[rng.randint(len(names))])
+        t.split[nid] = float(rng.randn() * 0.5)
+        t.default_left[nid] = bool(rng.rand() < 0.5)
+        left, right = t.add_children(nid)
+        grow(left, d + 1)
+        grow(right, d + 1)
+
+    grow(0, 0)
+    return t
+
+
+def build_gbdt(tmp_path, seed=4, n_trees=5, depth=3, names=FEATS, base=0.5):
+    """Hand-built ensemble round-tripped through the text dump, so the
+    served model went through the same parse as a trainer artifact."""
+    rng = np.random.RandomState(seed)
+    model = GBDTModel(
+        base_prediction=base,
+        num_tree_in_group=1,
+        obj_name="sigmoid",
+        trees=[_rand_tree(rng, names, depth) for _ in range(n_trees)],
+    )
+    path = tmp_path / "gbdt.model"
+    path.write_text(model.dumps())
+    cfg = {
+        "model": {"data_path": str(path)},
+        "optimization": {"loss_function": "sigmoid"},
+    }
+    return create_predictor("gbdt", cfg), list(names)
+
+
+def build_gbst(tmp_path, variant="gbmlr", seed=5, K=4, n_trees=2, names=FEATS):
+    """Hand-written tree-NNNNN part files in the GBST dump format."""
+    rng = np.random.RandomState(seed)
+    scalar = variant in ("gbsdt", "gbhsdt")
+    stride = (K - 1) if scalar else (2 * K - 1)
+    root = tmp_path / f"{variant}.model"
+    root.mkdir(parents=True, exist_ok=True)
+    for t in range(n_trees):
+        tdir = root / f"tree-{t:05d}"
+        tdir.mkdir()
+        lines = []
+        if scalar:
+            lines.append(f"k:{K}")
+            lines.append(",".join(f"{v:.6f}" for v in rng.randn(K)))
+        for nm in list(names) + ["_bias_"]:
+            lines.append(
+                nm + "," + ",".join(f"{v:.6f}" for v in rng.randn(stride))
+            )
+        (tdir / "part-0").write_text("\n".join(lines) + "\n")
+    (root / "tree-info").write_text(
+        f"finished_tree_num:{n_trees}\nuniform_base_prediction:0.0\n"
+    )
+    cfg = {
+        "model": {"data_path": str(root)},
+        "loss": {"loss_function": "sigmoid"},
+        "k": K,
+        "tree_num": n_trees,
+        "learning_rate": 0.3,
+    }
+    return create_predictor(variant, cfg), list(names)

@@ -1,0 +1,89 @@
+"""check_bench_regress serve gate: latency-schema pairs, p99 band, skips."""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
+
+from check_bench_regress import main as gate_main  # noqa: E402
+
+
+def _serve_artifact(tmp_path, rnd, qps, p99, retraces=0,
+                    metric="serve_req_per_sec_agaricus_gbdt", wrap=False):
+    rec = {
+        "schema_version": 1,
+        "schema": "serve_latency",
+        "metric": metric,
+        "value": qps,
+        "unit": "req/s",
+        "p99_ms": p99,
+        "retraces_after_warmup": retraces,
+    }
+    if wrap:  # the CI driver envelope shape
+        rec = {"cmd": "serve_bench", "rc": 0, "parsed": rec}
+    (tmp_path / f"SERVE_r{rnd:02d}.json").write_text(json.dumps(rec))
+
+
+def test_gate_skips_with_no_artifacts(tmp_path, capsys):
+    assert gate_main(["--dir", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "SKIP serve gate" in out and "SKIP train gate" in out
+
+
+def test_gate_skips_with_single_serve_artifact(tmp_path, capsys):
+    _serve_artifact(tmp_path, 9, 10000.0, 20.0)
+    assert gate_main(["--dir", str(tmp_path)]) == 0
+    assert "SKIP serve gate" in capsys.readouterr().out
+
+
+def test_gate_passes_comparable_pair(tmp_path, capsys):
+    _serve_artifact(tmp_path, 9, 10000.0, 20.0)
+    _serve_artifact(tmp_path, 10, 9500.0, 21.0, wrap=True)  # within bands
+    assert gate_main(["--dir", str(tmp_path)]) == 0
+    assert "serve p99" in capsys.readouterr().out
+
+
+def test_gate_fails_on_throughput_drop(tmp_path, capsys):
+    _serve_artifact(tmp_path, 9, 10000.0, 20.0)
+    _serve_artifact(tmp_path, 10, 5000.0, 20.0)
+    assert gate_main(["--dir", str(tmp_path)]) == 1
+    assert "serve throughput regressed" in capsys.readouterr().err
+
+
+def test_gate_fails_on_p99_band(tmp_path, capsys):
+    _serve_artifact(tmp_path, 9, 10000.0, 20.0)
+    _serve_artifact(tmp_path, 10, 11000.0, 40.0)
+    assert gate_main(["--dir", str(tmp_path)]) == 1
+    assert "p99 latency regressed" in capsys.readouterr().err
+
+
+def test_gate_fails_on_steady_state_retrace(tmp_path, capsys):
+    _serve_artifact(tmp_path, 9, 10000.0, 20.0)
+    _serve_artifact(tmp_path, 10, 11000.0, 19.0, retraces=3)
+    assert gate_main(["--dir", str(tmp_path)]) == 1
+    assert "retraces" in capsys.readouterr().err
+
+
+def test_gate_ignores_metric_mismatch_and_rot(tmp_path, capsys):
+    _serve_artifact(tmp_path, 8, 10000.0, 20.0, metric="serve_req_per_sec_other")
+    _serve_artifact(tmp_path, 9, 500.0, 99.0)  # different metric: no pair
+    (tmp_path / "SERVE_r10.json").write_text("{not json")
+    assert gate_main(["--dir", str(tmp_path)]) == 0
+    assert "SKIP serve gate" in capsys.readouterr().out
+
+
+def test_gate_real_recorded_artifact_shape():
+    """The checked-in SERVE_r09.json parses as a serve_latency record."""
+    from check_bench_regress import read_serve_record
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(repo, "SERVE_r09.json")
+    if not os.path.exists(path):
+        pytest.skip("no recorded serve artifact")
+    rec = read_serve_record(path)
+    assert rec["metric"].startswith("serve_req_per_sec")
+    assert rec["req_per_sec"] > 0 and rec["p99_ms"] > 0
+    assert rec["retraces"] == 0

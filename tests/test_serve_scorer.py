@@ -1,0 +1,153 @@
+"""CompiledScorer vs OnlinePredictor parity for every model family.
+
+The scorer is a *lowering* of the host predictor — same feature pipeline,
+same math, dense arrays instead of name-keyed maps — so every family must
+reproduce batch_scores: bit-for-bit for GBDT (tree-ascending float64
+accumulation, the serve_bench contract), and to float64 round-off for the
+matmul families (where summation order differs from the host loop).
+"""
+
+import numpy as np
+import pytest
+
+from serve_models import (
+    build_ffm,
+    build_fm,
+    build_gbdt,
+    build_gbst,
+    build_linear,
+    build_multiclass,
+    request_rows,
+)
+from ytklearn_tpu.serve import CompiledScorer, parse_ladder
+
+LADDER = (1, 4, 16)  # small rungs: tests exercise padding + chunking
+
+
+def _check_family(predictor, names, rng, exact=False, n=23):
+    rows = request_rows(n, rng, names)
+    scorer = CompiledScorer(predictor, ladder=LADDER)
+    got = scorer.score_batch(rows)
+    want = predictor.batch_scores(rows)
+    assert got.shape == np.asarray(want).shape
+    if exact:
+        np.testing.assert_array_equal(got, want)
+    else:
+        np.testing.assert_allclose(got, want, rtol=1e-10, atol=1e-12)
+    # activated predictions against the host batch path
+    preds = scorer.predict_batch(rows)
+    np.testing.assert_allclose(
+        preds, predictor.batch_predicts(rows), rtol=1e-9, atol=1e-12
+    )
+    return scorer
+
+
+def test_linear_parity(tmp_path):
+    pred, names = build_linear(tmp_path)
+    _check_family(pred, names, np.random.RandomState(10))
+
+
+def test_multiclass_parity(tmp_path):
+    pred, names = build_multiclass(tmp_path)
+    scorer = _check_family(pred, names, np.random.RandomState(11))
+    assert scorer.n_outputs == 4
+
+
+def test_fm_parity(tmp_path):
+    pred, names = build_fm(tmp_path)
+    _check_family(pred, names, np.random.RandomState(12))
+
+
+def test_ffm_parity(tmp_path):
+    pred, names = build_ffm(tmp_path)
+    _check_family(pred, names, np.random.RandomState(13))
+
+
+def test_gbdt_parity_bit_identical(tmp_path):
+    pred, names = build_gbdt(tmp_path)
+    _check_family(pred, names, np.random.RandomState(14), exact=True)
+
+
+def test_gbdt_missing_features_route_default(tmp_path):
+    pred, _names = build_gbdt(tmp_path)
+    scorer = CompiledScorer(pred, ladder=LADDER)
+    rows = [{}, {"c0": float("nan")}, {"c0": 0.1}]
+    np.testing.assert_array_equal(
+        scorer.score_batch(rows), pred.batch_scores(rows)
+    )
+
+
+@pytest.mark.parametrize("variant", ["gbmlr", "gbsdt", "gbhmlr", "gbhsdt"])
+def test_gbst_parity(tmp_path, variant):
+    pred, names = build_gbst(tmp_path, variant=variant)
+    _check_family(pred, names, np.random.RandomState(15))
+
+
+def test_ladder_no_steady_state_retrace(tmp_path):
+    """Mixed request sizes after warmup must not trigger a single new XLA
+    compile — the whole point of the padded shape ladder."""
+    from ytklearn_tpu.obs import configure, core, reset
+    from ytklearn_tpu.obs.health import install_trace_counters
+
+    pred, names = build_linear(tmp_path)
+    configure(enabled=True)
+    install_trace_counters()
+    try:
+        scorer = CompiledScorer(pred, ladder=(1, 4, 16))
+        baseline = core.REGISTRY.counters.get("compile.traces.backend_compile", 0.0)
+        rng = np.random.RandomState(16)
+        for n in (1, 2, 3, 4, 5, 7, 11, 16, 17, 33, 2, 1):
+            scorer.score_batch(request_rows(n, rng, names))
+        after = core.REGISTRY.counters.get("compile.traces.backend_compile", 0.0)
+        assert after == baseline, "steady-state retrace on the serve path"
+        assert core.REGISTRY.counters.get("health.retrace", 0.0) == 0.0
+    finally:
+        configure(enabled=False)
+        reset()
+
+
+def test_second_scorer_warmup_is_not_a_retrace(tmp_path):
+    """Hot reload warms a replacement scorer while the old one serves; its
+    warmup compiles must not trip the old scorer's armed sentinel."""
+    from ytklearn_tpu.obs import configure, core, reset
+    from ytklearn_tpu.obs.health import install_trace_counters
+
+    pred_a, names = build_linear(tmp_path)
+    pred_b, _ = build_gbdt(tmp_path)
+    configure(enabled=True)
+    install_trace_counters()
+    try:
+        rng = np.random.RandomState(18)
+        scorer_a = CompiledScorer(pred_a, ladder=(1, 4))
+        scorer_a.score_batch(request_rows(3, rng, names))  # steady state
+        CompiledScorer(pred_b, ladder=(1, 4))  # the reload warmup: compiles
+        scorer_a.score_batch(request_rows(2, rng, names))
+        assert core.REGISTRY.counters.get("health.retrace", 0.0) == 0.0
+    finally:
+        configure(enabled=False)
+        reset()
+
+
+def test_oversize_batch_chunks_to_ladder_top(tmp_path):
+    pred, names = build_linear(tmp_path)
+    scorer = CompiledScorer(pred, ladder=(1, 4))
+    rows = request_rows(11, np.random.RandomState(17), names)
+    np.testing.assert_allclose(
+        scorer.score_batch(rows), pred.batch_scores(rows), rtol=1e-10
+    )
+
+
+def test_empty_batch(tmp_path):
+    pred, _names = build_linear(tmp_path)
+    scorer = CompiledScorer(pred, ladder=LADDER)
+    assert scorer.score_batch([]).shape == (0,)
+
+
+def test_parse_ladder(monkeypatch):
+    assert parse_ladder("64,1,8,64") == (1, 8, 64)
+    monkeypatch.setenv("YTK_SERVE_LADDER", "2,32")
+    assert parse_ladder() == (2, 32)
+    monkeypatch.delenv("YTK_SERVE_LADDER")
+    assert parse_ladder() == (1, 8, 64, 512)
+    with pytest.raises(ValueError):
+        parse_ladder("0,4")

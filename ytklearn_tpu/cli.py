@@ -11,7 +11,12 @@ multi-host) instead of a TCP master.
 Console scripts (pyproject.toml):
   ytklearn-tpu-train   <model_name> <config_path> [options]
   ytklearn-tpu-predict <config_path> <model_name> <file_dir> [options]
-plus `python -m ytklearn_tpu.cli {train,predict,convert} ...`.
+  ytklearn-tpu-serve   <config_path> <model_name> [options]
+plus `python -m ytklearn_tpu.cli {train,predict,convert,serve} ...`.
+
+`serve` has no reference counterpart (the reference stops at the
+thread-safe OnlinePredictor library); it fronts that API with the
+compiled-scorer + micro-batching online layer (docs/serving.md).
 """
 
 from __future__ import annotations
@@ -337,10 +342,83 @@ def convert_main(argv: Optional[List[str]] = None) -> int:
     return 0
 
 
+def serve_main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="ytklearn-tpu-serve",
+        description="Online prediction server: compiled batch scorer with a "
+        "padded shape ladder, dynamic micro-batching with backpressure, and "
+        "fingerprint-watch hot model reload (docs/serving.md)",
+    )
+    ap.add_argument("config_path")
+    ap.add_argument("model_name", choices=MODEL_NAMES)
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=8080,
+                    help="listen port (0 picks an ephemeral port)")
+    ap.add_argument("--name", default="default",
+                    help="registry name for this model (the default target "
+                    "of /predict requests without a \"model\" field)")
+    ap.add_argument("--ladder", default="",
+                    help='compiled batch-shape ladder, e.g. "1,8,64,512" '
+                    "(default; env YTK_SERVE_LADDER). Every rung compiles "
+                    "once at load, so steady-state traffic never retraces")
+    ap.add_argument("--max-batch", type=int, default=512,
+                    help="max rows coalesced into one scorer call")
+    ap.add_argument("--max-wait-ms", type=float, default=2.0,
+                    help="micro-batch straggler wait after the first request")
+    ap.add_argument("--max-queue", type=int, default=2048,
+                    help="pending-request bound; beyond it requests are shed "
+                    "with a typed 429 instead of queueing unboundedly")
+    ap.add_argument("--deadline-ms", type=float, default=0.0,
+                    help="default per-request deadline (0 = none); expired "
+                    "requests fail with 504 before wasting scorer time")
+    ap.add_argument("--watch-interval", type=float, default=None,
+                    help="model-file fingerprint poll seconds for hot reload "
+                    "(default 5; 0 disables; env YTK_SERVE_WATCH_S)")
+    ap.add_argument("--set", action="append", dest="sets", metavar="KEY=VALUE",
+                    help="config override, repeatable")
+    ap.add_argument("--trace-out", default="",
+                    help="write a Chrome-trace/Perfetto JSON at shutdown")
+    ap.add_argument("--verbose", action="store_true")
+    args = ap.parse_args(argv)
+    _setup_logging(args.verbose)
+    _setup_trace(args.trace_out)
+
+    from .config import hocon
+    from .serve import BatchPolicy, ModelRegistry, ServeApp, parse_ladder
+
+    cfg = _apply_overrides(hocon.load(args.config_path), args.sets)
+    ladder = parse_ladder(args.ladder) if args.ladder else None
+    registry = ModelRegistry(ladder=ladder, watch_interval_s=args.watch_interval)
+    registry.load(args.name, args.model_name, cfg)
+    registry.start_watching()
+    policy = BatchPolicy(
+        max_batch=args.max_batch,
+        max_wait_ms=args.max_wait_ms,
+        max_queue=args.max_queue,
+        default_deadline_ms=args.deadline_ms,
+    )
+    app = ServeApp(registry, policy, host=args.host, port=args.port).start()
+    app.install_signal_handlers()
+    print(json.dumps({
+        "serving": args.name,
+        "model": args.model_name,
+        "host": args.host,
+        "port": app.port,
+        "ladder": list(registry.get(args.name).scorer.ladder),
+    }), flush=True)
+    try:
+        while app._serve_thread is not None and app._serve_thread.is_alive():
+            app._serve_thread.join(timeout=1.0)
+    except KeyboardInterrupt:
+        app.stop(drain=True)
+    _flush_trace(args.trace_out)
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help"):
-        print("usage: python -m ytklearn_tpu.cli {train,predict,convert} ...")
+        print("usage: python -m ytklearn_tpu.cli {train,predict,convert,serve} ...")
         return 0 if argv else 2
     cmd, rest = argv[0], argv[1:]
     if cmd == "train":
@@ -349,7 +427,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         return predict_main(rest)
     if cmd == "convert":
         return convert_main(rest)
-    print(f"unknown command {cmd!r}; expected train|predict|convert", file=sys.stderr)
+    if cmd == "serve":
+        return serve_main(rest)
+    print(f"unknown command {cmd!r}; expected train|predict|convert|serve", file=sys.stderr)
     return 2
 
 

@@ -26,6 +26,56 @@ from ..obs import heartbeat as obs_heartbeat, inc as obs_inc, span as obs_span
 log = logging.getLogger("ytklearn_tpu.predict")
 
 SAVE_MODES = ("predict_result_only", "label_and_predict", "predict_as_feature")
+
+#: losses whose predict() is the identity (LossFunction.predict default or
+#: the multiclass-margin identity override) — the activation fast path
+#: below must list them explicitly, because a wrong identity assumption
+#: would silently serve raw scores for e.g. sigmoid
+_IDENTITY_ACTIVATIONS = {
+    "l2", "l1", "huber", "mape", "inv_mape", "smape",
+    "hinge", "l2_hinge", "smooth_hinge", "exponential",
+    "multiclass_hinge", "multiclass_l2_hinge", "multiclass_smooth_hinge",
+    "base",
+}
+
+
+def _np_sigmoid(s):
+    s = np.asarray(s, np.float64)
+    t = np.exp(-np.abs(s))  # stable: never exponentiates a large positive
+    return np.where(s >= 0.0, 1.0 / (1.0 + t), t / (1.0 + t))
+
+
+def _np_softmax(s):
+    s = np.asarray(s, np.float64)
+    z = s - np.max(s, axis=-1, keepdims=True)
+    e = np.exp(z)
+    return e / np.sum(e, axis=-1, keepdims=True)
+
+
+def numpy_activation(loss):
+    """Host-numpy mirror of `loss.predict`, or None when only the jnp
+    implementation exists (hsoftmax's heap walk).
+
+    The per-sample serving hot path must not dispatch jnp per request: a
+    single `loss.predict(score)` call is a device round-trip (~100 ms
+    through a remote-chip tunnel — the same lesson batch_predict_from_files
+    already encodes for files). Predictors cache this per instance and fall
+    back to the jnp path for unknown losses, so results stay correct either
+    way; tests/test_predict_hotpath.py pins the no-dispatch contract."""
+    name = getattr(loss, "name", "")
+    if name in _IDENTITY_ACTIVATIONS:
+        return lambda s: s
+    if name == "sigmoid":
+        return _np_sigmoid
+    if name == "poisson":
+        from ..losses import _POISSON_MAX_EXP  # the one clamp, both paths
+
+        return lambda s: np.exp(
+            np.minimum(np.asarray(s, np.float64), _POISSON_MAX_EXP)
+        )
+    if name == "softmax":
+        return _np_softmax
+    return None
 #: reference enum-name aliases (ResultSaveMode.PREDICT_AS_FEATURE prints
 #: "label_as_feature", OnlinePredictor.java:55)
 SAVE_MODE_ALIASES = {"label_as_feature": "predict_as_feature"}
@@ -60,8 +110,21 @@ class OnlinePredictor:
     def scores(self, features: Dict[str, float], other=None) -> List[float]:
         return [self.score(features, other)]
 
+    def _activation(self):
+        """Cached numpy_activation(self.loss); None -> jnp fallback. Lazy
+        (not in __init__) so subclasses that set self.loss late still work;
+        the racy first computation is idempotent, so no lock."""
+        act = self.__dict__.get("_np_act", False)
+        if act is False:
+            act = self.__dict__["_np_act"] = numpy_activation(self.loss)
+        return act
+
     def predict(self, features: Dict[str, float], other=None) -> float:
-        return float(self.loss.predict(self.score(features, other)))
+        s = self.score(features, other)
+        act = self._activation()
+        if act is not None:
+            return float(act(s))
+        return float(self.loss.predict(s))
 
     def predicts(self, features: Dict[str, float], other=None) -> List[float]:
         return [self.predict(features, other)]
@@ -82,7 +145,11 @@ class OnlinePredictor:
         return out if self.n_outputs > 1 else out[:, 0]
 
     def batch_predicts(self, rows, others=None) -> np.ndarray:
-        return np.asarray(self.loss.predict(self.batch_scores(rows, others)))
+        s = self.batch_scores(rows, others)
+        act = self._activation()
+        if act is not None:
+            return np.asarray(act(s))
+        return np.asarray(self.loss.predict(s))
 
 
 def parse_feature_kvs(text: str, delim) -> Dict[str, float]:

@@ -146,6 +146,9 @@ class LinearPredictor(ContinuousPredictor):
             param = self.model_map.get(p.bias_feature_name)
             if param is not None:
                 s += param[0]
+        act = self._activation()
+        if act is not None:
+            return float(act(s))
         return float(self.loss.predict(s))
 
 
@@ -182,7 +185,10 @@ class MulticlassLinearPredictor(ContinuousPredictor):
         raise ValueError("multiclass_linear is multi-output; use scores()")
 
     def predicts(self, features, other=None) -> List[float]:
-        return [float(v) for v in self.loss.predict(np.asarray(self.scores(features)))]
+        s = np.asarray(self.scores(features))
+        act = self._activation()
+        out = act(s) if act is not None else self.loss.predict(s)
+        return [float(v) for v in out]
 
     def predict(self, features, other=None) -> float:
         raise ValueError("multiclass_linear is multi-output; use predicts()")

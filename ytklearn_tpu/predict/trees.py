@@ -95,10 +95,16 @@ class GBDTPredictor(OnlinePredictor):
         return s
 
     def predict(self, features, other=None) -> float:
-        return float(self.loss.predict(self.score(features, other)))
+        s = self.score(features, other)
+        act = self._activation()
+        if act is not None:
+            return float(act(s))
+        return float(self.loss.predict(s))
 
     def predicts(self, features, other=None) -> List[float]:
-        out = self.loss.predict(np.asarray(self.scores(features, other)))
+        s = np.asarray(self.scores(features, other))
+        act = self._activation()
+        out = act(s) if act is not None else self.loss.predict(s)
         return [float(v) for v in np.atleast_1d(out)]
 
     def loss_value(self, features, label, other=None) -> float:

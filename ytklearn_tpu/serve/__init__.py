@@ -1,0 +1,49 @@
+"""ytklearn_tpu.serve — the online serving layer (docs/serving.md).
+
+The reference ships a thread-safe `predictor/OnlinePredictor.java` API and
+stops there; this layer is the rest of the serving story the ROADMAP north
+star asks for ("serve heavy traffic from millions of users"):
+
+  CompiledScorer   lowers a loaded OnlinePredictor into dense arrays and
+                   jit-compiles a padded batch-shape ladder (1/8/64/512 by
+                   default, knob YTK_SERVE_LADDER) with warmup-on-load, so
+                   varying request sizes never retrace in steady state
+  MicroBatcher     Clipper-style dynamic micro-batching queue (max batch /
+                   max wait knobs) with a bounded depth that sheds load
+                   when full, per-request deadlines, and graceful drain
+  ModelRegistry    multi-model registry with fingerprint-watch hot reload:
+                   the replacement scorer is warmed BEFORE an atomic swap
+  ServeApp         stdlib ThreadingHTTPServer exposing /predict, /healthz,
+                   /readyz, and /metrics (obs registry snapshot + latency
+                   percentiles); SIGTERM drains in-flight work
+
+CLI: `python -m ytklearn_tpu.cli serve <conf> <model_name>` /
+`ytklearn-tpu-serve` (cli.py).
+"""
+
+from __future__ import annotations
+
+from .batcher import (  # noqa: F401
+    BatchPolicy,
+    DeadlineExceeded,
+    MicroBatcher,
+    OverloadError,
+    ServeClosed,
+)
+from .registry import ModelRegistry, model_fingerprint  # noqa: F401
+from .scorer import DEFAULT_LADDER, CompiledScorer, parse_ladder  # noqa: F401
+from .server import ServeApp  # noqa: F401
+
+__all__ = [
+    "BatchPolicy",
+    "CompiledScorer",
+    "DEFAULT_LADDER",
+    "DeadlineExceeded",
+    "MicroBatcher",
+    "ModelRegistry",
+    "OverloadError",
+    "ServeApp",
+    "ServeClosed",
+    "model_fingerprint",
+    "parse_ladder",
+]

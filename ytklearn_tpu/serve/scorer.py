@@ -1,0 +1,539 @@
+"""CompiledScorer — lower a loaded OnlinePredictor into jitted batch kernels.
+
+The predictor side-stack (predict/) walks name-keyed hash maps per sample on
+the host: correct, thread-safe, and ~1k req/s. Serving throughput comes from
+the XGBoost/Clipper lesson — amortize per-request overhead into fixed-shape
+batches — which on TPU additionally means a *bucketed-shape ladder*: requests
+are padded up to the smallest compiled rung (default 1/8/64/512, knob
+YTK_SERVE_LADDER), so mixed request sizes hit at most len(ladder) XLA
+compilations, all of them at warmup. The r8 RetraceSentinel watches the
+steady state; a post-warmup compile fires `health.retrace`.
+
+Lowering per family (model maps -> dense arrays, request dicts -> rows):
+
+  linear            score = X @ w + bias
+  multiclass_linear scores = [X @ W + b, 0]
+  fm                wx + 1/2 Σ_k[(X V)² − X² V²]; bias rides as an x=1 column
+  ffm               field-aware pairwise terms via a (B,F,F,k) field-block
+                    einsum (exactly the Σ_{p<q} host sum, closed form)
+  gbdt              stacked node arrays, fixed-depth vectorized traversal;
+                    accumulation runs tree-ascending in float64, so scores
+                    are BIT-IDENTICAL to OnlinePredictor.batch_scores
+                    (scripts/serve_bench.py asserts this)
+  gbmlr/gbsdt/...   stacked per-tree expert/gate matrices, softmax or
+                    heap-sigmoid gating
+
+Host featurization stays the predictor's own `_prep` (hashing + transform
+replay), so a served request sees byte-for-byte the same feature pipeline as
+the offline path. Sample-dependent base predictions (`other`) are an offline
+concept and not supported here.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..obs import health as obs_health
+from ..obs import inc as obs_inc, span as obs_span
+from ..predict.base import OnlinePredictor
+from ..predict.continuous import (
+    FFMPredictor,
+    FMPredictor,
+    LinearPredictor,
+    MulticlassLinearPredictor,
+)
+from ..predict.trees import GBDTPredictor, GBSTPredictor
+
+DEFAULT_LADDER = (1, 8, 64, 512)
+
+#: XLA compiles attributed to scorer warmups (process-wide, GIL-guarded).
+#: The retrace sentinel watches a process-GLOBAL compile counter; without
+#: this credit, warming a replacement scorer (hot reload) or a second
+#: model would falsely fire health.retrace on every already-armed scorer.
+#: While a warmup is IN PROGRESS its compiles have landed in the global
+#: counter but not yet in the credit, so armed scorers skip checks for the
+#: duration and re-baseline on their next batch (_warmups_in_progress).
+_warmup_compile_credit = 0.0
+_warmups_in_progress = 0
+
+
+class _LadderRetraceSentinel(obs_health.RetraceSentinel):
+    """RetraceSentinel that discounts compiles other scorers' warmups did."""
+
+    @staticmethod
+    def _compiles() -> float:
+        return obs_health.RetraceSentinel._compiles() - _warmup_compile_credit
+
+
+def parse_ladder(spec: Optional[str] = None) -> Tuple[int, ...]:
+    """YTK_SERVE_LADDER="1,8,64,512" -> sorted unique rung tuple."""
+    if spec is None:
+        spec = os.environ.get("YTK_SERVE_LADDER", "")
+    if not spec:
+        return DEFAULT_LADDER
+    rungs = sorted({int(v) for v in str(spec).split(",") if v.strip()})
+    if not rungs or rungs[0] < 1:
+        raise ValueError(f"bad serve ladder {spec!r}: rungs must be >= 1")
+    return tuple(rungs)
+
+
+class CompiledScorer:
+    """Batch scorer for one loaded model; thread-safe after construction
+    (score paths touch only immutable arrays + jit caches)."""
+
+    def __init__(
+        self,
+        predictor: OnlinePredictor,
+        ladder: Optional[Sequence[int]] = None,
+        warmup: bool = True,
+    ):
+        import jax
+
+        self.predictor = predictor
+        self.ladder = tuple(sorted(set(ladder))) if ladder else parse_ladder()
+        self.n_outputs = predictor.n_outputs
+        self._fill = 0.0  # pad/absent-feature value; NaN for gbdt (missing)
+        self._bias_col: Optional[int] = None
+        self._lower()
+        self.dim = len(self.vocab) + (1 if self._bias_col is not None else 0)
+        self._jit = jax.jit(self._kernel)
+        # post-warmup compiles are a bug (the ladder exists to prevent
+        # them); the sentinel makes one fire health.retrace loudly
+        obs_health.install_trace_counters()
+        self._sentinel = _LadderRetraceSentinel("serve.scorer")
+        self._warm = False
+        self._rearm_pending = False
+        if warmup:
+            self.warmup()
+
+    # -- public API -------------------------------------------------------
+
+    def warmup(self) -> None:
+        """Compile every ladder rung now (load time), then arm the retrace
+        sentinel — steady-state traffic must never compile again. The
+        compiles this causes are credited so scorers already armed (hot
+        reload warms the replacement while the old one still serves) don't
+        count them as steady-state retraces."""
+        global _warmup_compile_credit, _warmups_in_progress
+        before = obs_health.RetraceSentinel._compiles()
+        _warmups_in_progress += 1
+        try:
+            with obs_span("serve.warmup", rungs=len(self.ladder)):
+                for rung in self.ladder:
+                    X = np.full((rung, self.dim), self._fill, np.float64)
+                    s, p = self._jit(X)
+                    np.asarray(s), np.asarray(p)  # block: compile+execute now
+                    obs_inc("serve.scorer.warmup_rungs")
+        finally:
+            # credit BEFORE dropping the in-progress flag, so once the flag
+            # clears the subtraction is already settled
+            _warmup_compile_credit += (
+                obs_health.RetraceSentinel._compiles() - before
+            )
+            _warmups_in_progress -= 1
+        self._sentinel.arm()
+        self._warm = True
+
+    def featurize(self, rows: Sequence[Dict[str, float]]) -> np.ndarray:
+        """Request dicts -> dense (B, dim) float64 via the predictor's own
+        host pipeline (hash + transform replay; raw values for gbdt)."""
+        X = np.full((len(rows), self.dim), self._fill, np.float64)
+        vocab = self.vocab
+        ii: List[int] = []
+        jj: List[int] = []
+        vv: List[float] = []
+        for i, fmap in enumerate(rows):
+            for name, val in self._prep(fmap):
+                j = vocab.get(name)
+                if j is not None:
+                    ii.append(i)
+                    jj.append(j)
+                    vv.append(val)
+        if ii:
+            X[ii, jj] = vv  # one vectorized scatter, not len(ii) writes
+        if self._bias_col is not None:
+            X[:, self._bias_col] = 1.0
+        return X
+
+    def score_batch(self, rows: Sequence[Dict[str, float]]) -> np.ndarray:
+        """Raw scores, shape (B,) or (B, K) — the batch_scores contract."""
+        return self._run(rows)[0]
+
+    def predict_batch(self, rows: Sequence[Dict[str, float]]) -> np.ndarray:
+        """Activated predictions (loss.predict applied in-kernel)."""
+        return self._run(rows)[1]
+
+    def score_and_predict(
+        self, rows: Sequence[Dict[str, float]]
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        return self._run(rows)
+
+    # -- execution --------------------------------------------------------
+
+    def _rung_for(self, n: int) -> int:
+        for r in self.ladder:
+            if r >= n:
+                return r
+        return self.ladder[-1]
+
+    def _run(self, rows) -> Tuple[np.ndarray, np.ndarray]:
+        X = self.featurize(rows)
+        B = X.shape[0]
+        max_rung = self.ladder[-1]
+        out_s: List[np.ndarray] = []
+        out_p: List[np.ndarray] = []
+        for start in range(0, max(B, 1), max_rung):
+            chunk = X[start : start + max_rung]
+            if chunk.shape[0] == 0:
+                break
+            rung = self._rung_for(chunk.shape[0])
+            pad = rung - chunk.shape[0]
+            if pad:
+                chunk = np.concatenate(
+                    [chunk, np.full((pad, self.dim), self._fill, np.float64)]
+                )
+            with obs_span("serve.score", rung=rung, rows=rung - pad):
+                s, p = self._jit(chunk)
+                s = np.asarray(s)
+                p = np.asarray(p)
+            obs_inc("serve.scorer.batches")
+            obs_inc("serve.scorer.rows", rung - pad)
+            obs_inc("serve.scorer.pad_rows", pad)
+            out_s.append(s[: rung - pad])
+            out_p.append(p[: rung - pad])
+        if self._warm:
+            if _warmups_in_progress:
+                # another scorer is mid-warmup: its compiles are in the
+                # global counter but not yet credited — don't judge, and
+                # take a fresh baseline once the dust settles
+                self._rearm_pending = True
+            elif self._rearm_pending:
+                self._sentinel.arm()
+                self._rearm_pending = False
+            else:
+                self._sentinel.check(rows=B)
+        if not out_s:
+            shape = (0,) if self.n_outputs == 1 else (0, self.n_outputs)
+            return np.empty(shape, np.float64), np.empty(shape, np.float64)
+        return np.concatenate(out_s), np.concatenate(out_p)
+
+    # -- lowering ---------------------------------------------------------
+
+    def _lower(self) -> None:
+        pred = self.predictor
+        if isinstance(pred, LinearPredictor):
+            self._lower_linear()
+        elif isinstance(pred, MulticlassLinearPredictor):
+            self._lower_multiclass()
+        elif isinstance(pred, FMPredictor):
+            self._lower_fm()
+        elif isinstance(pred, FFMPredictor):
+            self._lower_ffm()
+        elif isinstance(pred, GBDTPredictor):
+            self._lower_gbdt()
+        elif isinstance(pred, GBSTPredictor):
+            self._lower_gbst()
+        else:
+            raise TypeError(
+                f"no compiled lowering for {type(pred).__name__}"
+            )
+
+    def _continuous_vocab(self, names) -> None:
+        """Shared vocab + bias-column plumbing for the _prep families."""
+        pred = self.predictor
+        bias_name = pred.params.model.bias_feature_name
+        self.vocab = {n: i for i, n in enumerate(sorted(names))}
+        self._prep = pred._prep
+        if pred.params.model.need_bias and bias_name in pred.model_map:
+            self._bias_col = len(self.vocab)
+            self._bias_name = bias_name
+        else:
+            self._bias_col = None
+
+    def _act(self):
+        """loss.predict as an in-kernel activation closure."""
+        loss = self.predictor.loss
+        return loss.predict
+
+    def _lower_linear(self) -> None:
+        pred = self.predictor
+        bias_name = pred.params.model.bias_feature_name
+        self._continuous_vocab(n for n in pred.model_map if n != bias_name)
+        D = len(self.vocab) + (1 if self._bias_col is not None else 0)
+        w = np.zeros(D, np.float64)
+        for n, j in self.vocab.items():
+            w[j] = pred.model_map[n][0]
+        if self._bias_col is not None:
+            w[self._bias_col] = pred.model_map[bias_name][0]
+        act = self._act()
+
+        def kernel(X):
+            s = X @ w
+            return s, act(s)
+
+        self._kernel = kernel
+
+    def _lower_multiclass(self) -> None:
+        import jax.numpy as jnp
+
+        pred = self.predictor
+        bias_name = pred.params.model.bias_feature_name
+        self._continuous_vocab(n for n in pred.model_map if n != bias_name)
+        K = pred.K
+        D = len(self.vocab) + (1 if self._bias_col is not None else 0)
+        W = np.zeros((D, K - 1), np.float64)
+        for n, j in self.vocab.items():
+            W[j] = pred.model_map[n]
+        if self._bias_col is not None:
+            W[self._bias_col] = pred.model_map[bias_name]
+        act = self._act()
+
+        def kernel(X):
+            s = X @ W
+            s = jnp.concatenate([s, jnp.zeros((X.shape[0], 1), s.dtype)], axis=-1)
+            return s, act(s)
+
+        self._kernel = kernel
+
+    def _lower_fm(self) -> None:
+        import jax.numpy as jnp
+
+        pred = self.predictor
+        bias_name = pred.params.model.bias_feature_name
+        self._continuous_vocab(n for n in pred.model_map if n != bias_name)
+        k = pred.sok
+        D = len(self.vocab) + (1 if self._bias_col is not None else 0)
+        w = np.zeros(D, np.float64)
+        V = np.zeros((D, k), np.float64)
+        for n, j in self.vocab.items():
+            row = pred.model_map[n]
+            if pred.need_first_order:
+                w[j] = row[0]
+            V[j] = row[1 : 1 + k]
+        if self._bias_col is not None:
+            # bias adds its weight + latent row at x=1 regardless of the
+            # first-order flag (FMOnlinePredictor semantics)
+            row = pred.model_map[bias_name]
+            w[self._bias_col] = row[0]
+            V[self._bias_col] = row[1 : 1 + k]
+        act = self._act()
+
+        def kernel(X):
+            S = X @ V
+            S2 = (X * X) @ (V * V)
+            s = X @ w + 0.5 * jnp.sum(S * S - S2, axis=-1)
+            return s, act(s)
+
+        self._kernel = kernel
+
+    def _lower_ffm(self) -> None:
+        import jax.numpy as jnp
+
+        pred = self.predictor
+        bias_name = pred.params.model.bias_feature_name
+        # unknown-field features are dropped entirely at serve time too
+        names = [
+            n
+            for n in pred.model_map
+            if n != bias_name and pred._field_of(n) >= 0
+        ]
+        self._continuous_vocab(names)
+        k, F = pred.sok, pred.n_fields
+        D = len(self.vocab) + (1 if self._bias_col is not None else 0)
+        w = np.zeros(D, np.float64)
+        V = np.zeros((D, F, k), np.float64)
+        field_idx = np.zeros(D, np.int32)
+        for n, j in self.vocab.items():
+            row = pred.model_map[n]
+            if pred.need_first_order:
+                w[j] = row[0]
+            V[j] = row[1 : 1 + F * k].reshape(F, k)
+            field_idx[j] = pred._field_of(n)
+        if self._bias_col is not None:
+            row = pred.model_map[bias_name]
+            w[self._bias_col] = row[0]
+            if k > 0:
+                V[self._bias_col] = row[1 : 1 + F * k].reshape(F, k)
+            field_idx[self._bias_col] = 0  # bias rides as a field-0, x=1 row
+        M = np.zeros((D, F), np.float64)
+        M[np.arange(D), field_idx] = 1.0
+        # per-feature self-interaction norm |V_d[f_d]|² — subtracted once so
+        # the closed form equals the host's strict p<q pair sum
+        sn = np.einsum("dk,dk->d", V[np.arange(D), field_idx], V[np.arange(D), field_idx])
+        act = self._act()
+
+        def kernel(X):
+            wx = X @ w
+            T = jnp.einsum("da,dfk,bd->bafk", M, V, X)
+            Q = jnp.einsum("bafk,bfak->b", T, T)
+            diag = (X * X) @ sn
+            s = wx + 0.5 * (Q - diag)
+            return s, act(s)
+
+        self._kernel = kernel
+
+    def _lower_gbdt(self) -> None:
+        import jax.numpy as jnp
+        from jax import lax
+
+        pred = self.predictor
+        model = pred.model
+        K = pred.K
+        T = pred.use_rounds * K
+        trees = model.trees[:T]
+        # leaf-only trees contribute no names; the vocab may be empty
+        names = sorted(
+            {nm for t in trees for i, nm in enumerate(t.feat_name) if not t.is_leaf(i)}
+        )
+        self.vocab = {n: i for i, n in enumerate(names)}
+        self._bias_col = None
+        self._fill = math.nan  # absent feature routes to the default child
+
+        def _prep(fmap: Dict[str, float]):
+            return fmap.items()
+
+        self._prep = _prep
+
+        N = max((t.n_nodes() for t in trees), default=1)
+        feat = np.full((max(T, 1), N), -1, np.int32)
+        split = np.zeros((max(T, 1), N), np.float64)
+        left = np.zeros((max(T, 1), N), np.int32)
+        right = np.zeros((max(T, 1), N), np.int32)
+        dleft = np.ones((max(T, 1), N), np.int32)
+        leaf = np.zeros((max(T, 1), N), np.float64)
+        for ti, t in enumerate(trees):
+            n = t.n_nodes()
+            for nid in range(n):
+                if not t.is_leaf(nid):
+                    feat[ti, nid] = self.vocab[t.feat_name[nid]]
+            split[ti, :n] = t.split
+            left[ti, :n] = t.left
+            right[ti, :n] = t.right
+            dleft[ti, :n] = np.asarray(t.default_left, np.int32)
+            leaf[ti, :n] = t.leaf_value
+        depth = max((t.max_depth() for t in trees), default=0)
+        is_rf = pred.learn_type == "random_forest"
+        rounds = max(pred.use_rounds, 1)
+        base = float(model.base_prediction)
+        act = self._act()
+        # device-resident constants: fori_loop indexes them with a traced t
+        feat, split, left, right, dleft, leaf = (
+            jnp.asarray(a) for a in (feat, split, left, right, dleft, leaf)
+        )
+
+        def kernel(X):
+            B = X.shape[0]
+            rowsB = jnp.arange(B)[:, None]  # (B, 1)
+            tids = jnp.arange(max(T, 1))[None, :]  # (1, T)
+            # walk EVERY tree at once: `depth` steps over (B, T) frontiers
+            # instead of T sequential per-tree loops — the tiny-op tail was
+            # the serve kernel's bottleneck on CPU
+            node = jnp.zeros((B, max(T, 1)), jnp.int32)
+            for _ in range(depth):
+                f = feat[tids, node]
+                v = X[rowsB, jnp.maximum(f, 0)]
+                go_left = jnp.where(
+                    jnp.isnan(v), dleft[tids, node] > 0, v <= split[tids, node]
+                )
+                nxt = jnp.where(go_left, left[tids, node], right[tids, node])
+                node = jnp.where(f < 0, node, nxt)
+            contrib = leaf[tids, node]  # (B, T)
+
+            # tree-ascending sequential accumulation in f64: bit-identical
+            # to the host predictor's walk (serve_bench pins this); a
+            # jnp.sum would reassociate the adds and drift in the last ulp
+            if K == 1:
+                s = lax.fori_loop(
+                    0, T, lambda t, s: s + contrib[:, t],
+                    jnp.zeros(B, jnp.float64),
+                )
+            else:
+                s = lax.fori_loop(
+                    0, T, lambda t, s: s.at[:, t % K].add(contrib[:, t]),
+                    jnp.zeros((B, K), jnp.float64),
+                )
+            if is_rf:
+                s = s / rounds
+            s = s + base
+            return s, act(s)
+
+        self._kernel = kernel
+
+    def _lower_gbst(self) -> None:
+        import jax.numpy as jnp
+        from jax import lax
+
+        pred = self.predictor
+        K = pred.K
+        T = pred.n_trees
+        stride = pred.stride
+        bias_name = pred.params.model.bias_feature_name
+        names = sorted({n for tmap in pred.tree_maps for n in tmap})
+        has_bias = pred.params.model.need_bias
+        if has_bias:
+            names = [n for n in names if n != bias_name]
+        self.vocab = {n: i for i, n in enumerate(sorted(names))}
+        self._bias_col = len(self.vocab) if has_bias else None
+        self._prep = pred._prep  # bias handled via the dedicated column
+        D = len(self.vocab) + (1 if has_bias else 0)
+        W = np.zeros((max(T, 1), D, stride), np.float64)
+        for ti, tmap in enumerate(pred.tree_maps):
+            for n, row in tmap.items():
+                if has_bias and n == bias_name:
+                    W[ti, self._bias_col] = row
+                elif n in self.vocab:
+                    W[ti, self.vocab[n]] = row
+        leaves = np.stack(pred.leaves) if pred.leaves else np.zeros((1, K))
+        W = jnp.asarray(W)  # fori_loop indexes with a traced t
+        leaves = jnp.asarray(leaves)
+        hier = pred.hier
+        scalar = pred.scalar_leaves
+        lr = pred.lr
+        is_rf = pred.is_rf
+        base = pred.base_score
+        levels = int(math.log2(K)) if K > 1 else 0
+        act = self._act()
+
+        def gate(gate_in):
+            B = gate_in.shape[0]
+            if hier:
+                sig = 1.0 / (1.0 + jnp.exp(-gate_in))
+                level = jnp.ones((B, 1), gate_in.dtype)
+                for _ in range(levels):
+                    n = level.shape[1]
+                    gates = sig[:, n - 1 : 2 * n - 1]
+                    level = jnp.stack(
+                        [level * gates, level * (1.0 - gates)], axis=-1
+                    ).reshape(B, 2 * n)
+                return level
+            z = jnp.concatenate([gate_in, jnp.zeros((B, 1), gate_in.dtype)], -1)
+            z = z - jnp.max(z, axis=-1, keepdims=True)
+            e = jnp.exp(z)
+            return e / jnp.sum(e, axis=-1, keepdims=True)
+
+        def kernel(X):
+            B = X.shape[0]
+
+            def per_tree(t, z):
+                if scalar:
+                    gate_in = X @ W[t]
+                    experts = leaves[t][None, :]
+                else:
+                    gate_in = X @ W[t][:, : K - 1]
+                    experts = X @ W[t][:, K - 1 :]
+                pi = gate(gate_in)
+                fx = jnp.sum(pi * experts, axis=-1)
+                return z + lr * fx
+
+            z = jnp.full((B,), base, jnp.float64)
+            z = lax.fori_loop(0, T, per_tree, z) if T else z
+            if is_rf:
+                z = z / max(T, 1)
+            return z, act(z)
+
+        self._kernel = kernel
