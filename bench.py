@@ -55,6 +55,7 @@ import time
 import numpy as np
 
 from ytklearn_tpu import obs
+from ytklearn_tpu.config import knobs
 
 log = logging.getLogger("ytklearn_tpu.bench")
 
@@ -83,9 +84,8 @@ SYNTH_BAND = {"auc": (0.9489, 0.005), "logloss": (0.3118, 0.02)}
 
 
 def higgs_dir() -> str:
-    return os.environ.get(
-        "YTK_HIGGS_DIR",
-        os.path.join(os.path.dirname(os.path.abspath(__file__)), "experiment", "higgs"),
+    return knobs.get_str("YTK_HIGGS_DIR") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "experiment", "higgs"
     )
 
 
@@ -213,7 +213,7 @@ def roofline_fields(stats: dict, n_trees: int) -> dict:
     """Achieved-vs-peak utilization + per-phase seconds from the obs stats
     snapshot (gbdt_stats_from_obs) and the engine's device wave log."""
     ts = dict(stats)
-    chip = os.environ.get("YTK_CHIP", "v5e")
+    chip = knobs.get_str("YTK_CHIP")
     peaks = CHIP_PEAKS.get(chip, CHIP_PEAKS["v5e"])
     hist = os.environ.get("BENCH_HIST", "int8")
     mxu_peak = peaks["int8" if hist == "int8" else "bf16"]
@@ -374,7 +374,7 @@ def main() -> None:
     # YTK_TRACE=path additionally writes the Perfetto trace at exit.
     # YTK_OBS=0 stays the documented force-off (overhead A/B runs) — the
     # roofline then falls back to trainer.time_stats.
-    if os.environ.get("YTK_OBS") != "0":
+    if knobs.get_raw("YTK_OBS") != "0":
         obs.configure(enabled=True)
         # run-health layer: flight ring for postmortems + compile counters
         # feeding the retrace sentinel (docs/observability.md)

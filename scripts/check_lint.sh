@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# Static pass: ytklint (the project's JAX/TPU-aware AST rules — see
+# docs/static_analysis.md) over the library, scripts, and bench.py, plus
+# the knob-registry <-> running-guide doc-sync check (both directions).
+# Runs in well under a second; wired into the tier-1 verify recipe next to
+# check_no_print.sh (now a delegating wrapper), check_suite_time.sh and
+# check_bench_regress.py (ROADMAP.md).
+#
+# Usage: scripts/check_lint.sh [ytklint args…]
+#   scripts/check_lint.sh                        # full repo pass
+#   scripts/check_lint.sh --select bare-print ytklearn_tpu
+#   scripts/check_lint.sh --list-rules
+set -o pipefail
+cd "$(dirname "$0")/.."
+
+rc=0
+python -m tools.ytklint "$@" || rc=1
+# the doc-sync half only makes sense on a full default run
+if [ "$#" -eq 0 ]; then
+    python -m ytklearn_tpu.config.knobs check docs/running_guide.md || rc=1
+fi
+exit $rc

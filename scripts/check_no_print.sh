@@ -1,40 +1,9 @@
 #!/usr/bin/env bash
-# Static pass: no bare print() in library code. Progress/diagnostic output
-# must go through logging or the obs heartbeat (ytklearn_tpu/obs/) so every
-# run produces structured, exportable evidence — stderr prints are invisible
-# to the trace/JSONL exporters and unfilterable in production.
-#
-# Allowlist: ytklearn_tpu/cli.py (the CLI's JSON result lines ARE its
-# stdout contract). Everything else under ytklearn_tpu/ is checked.
-# AST-based: real print CALLS only, not strings/comments/docstrings.
+# Static pass: no bare print() in library code (allowlist: cli.py, whose
+# JSON result lines ARE its stdout contract). Since ytklint absorbed this
+# check as its `bare-print` rule, this script is a thin delegating wrapper
+# so the ROADMAP verify recipe keeps working unchanged; the rule itself
+# lives in tools/ytklint/rules.py (docs/static_analysis.md).
 #
 # Usage: scripts/check_no_print.sh    (exit 1 + offending lines on failure)
-set -o pipefail
-cd "$(dirname "$0")/.."
-
-python - <<'EOF'
-import ast
-import pathlib
-import sys
-
-ALLOW = {pathlib.Path("ytklearn_tpu/cli.py")}
-bad = []
-for path in sorted(pathlib.Path("ytklearn_tpu").rglob("*.py")):
-    if path in ALLOW:
-        continue
-    tree = ast.parse(path.read_text(), str(path))
-    for node in ast.walk(tree):
-        if (
-            isinstance(node, ast.Call)
-            and isinstance(node.func, ast.Name)
-            and node.func.id == "print"
-        ):
-            bad.append(f"{path}:{node.lineno}: bare print()")
-
-if bad:
-    print("\n".join(bad), file=sys.stderr)
-    print("FAIL: bare print() in library code — use logging or", file=sys.stderr)
-    print("      ytklearn_tpu.obs.heartbeat (allowlist: cli.py)", file=sys.stderr)
-    sys.exit(1)
-print("check_no_print: OK")
-EOF
+exec "$(dirname "$0")/check_lint.sh" --select bare-print ytklearn_tpu

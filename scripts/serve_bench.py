@@ -41,6 +41,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np  # noqa: E402
 
+from ytklearn_tpu.config import knobs  # noqa: E402
+
 REF = "/root/reference"
 
 
@@ -205,7 +207,7 @@ def main() -> int:
     from ytklearn_tpu.obs import health
     from ytklearn_tpu.serve import CompiledScorer
 
-    if os.environ.get("YTK_OBS") != "0":
+    if knobs.get_raw("YTK_OBS") != "0":
         obs.configure(enabled=True)
         health.install_trace_counters()
 

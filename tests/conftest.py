@@ -32,6 +32,49 @@ jax.config.update("jax_enable_x64", True)
 import pytest  # noqa: E402
 
 
+def pytest_addoption(parser):
+    parser.addoption(
+        "--ytk-sanitize",
+        action="store_true",
+        default=False,
+        help="run @pytest.mark.hotpath tests under "
+        "jax.transfer_guard('disallow') + jax_debug_nans, proving the jit "
+        "hot paths perform no implicit host<->device transfer and produce "
+        "no NaNs (docs/static_analysis.md, 'Runtime sanitizer mode')",
+    )
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "hotpath(subsystem): marks a steady-state jit hot-path test; under "
+        "--ytk-sanitize it runs with the transfer guard set to disallow "
+        "and jax_debug_nans on — the runtime pin of the ytklint "
+        "host-sync-in-jit rule",
+    )
+
+
+@pytest.fixture(autouse=True)
+def _ytk_sanitizer(request):
+    """With --ytk-sanitize, wrap marked hot-path tests in the real tracer's
+    guards. Module-scoped fixtures (model builds, warmup compiles — load
+    time, where transfers are legitimate) set up BEFORE this function-scoped
+    fixture, so the guard covers exactly the steady-state body."""
+    if not (
+        request.config.getoption("--ytk-sanitize")
+        and request.node.get_closest_marker("hotpath")
+    ):
+        yield
+        return
+    prev_nans = jax.config.jax_debug_nans
+    jax.config.update("jax_debug_nans", True)
+    try:
+        with jax.transfer_guard("disallow"):
+            yield
+    finally:
+        jax.config.update("jax_debug_nans", prev_nans)
+
+
 @pytest.fixture(scope="session")
 def devices():
     return jax.devices()

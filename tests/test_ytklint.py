@@ -1,0 +1,422 @@
+"""ytklint self-tests: per-rule fixtures + the repo-wide clean gate.
+
+Each rule gets (at least) one failing snippet, one passing snippet, and a
+suppression check — the fixture contract from ISSUE 5. The repo-wide test
+is the actual gate: ytklint must run clean over ytklearn_tpu/, scripts/
+and bench.py, and the knob registry must match the running-guide table in
+both directions.
+"""
+
+import textwrap
+
+import pytest
+
+from tools.ytklint import RULES, lint_paths, lint_source
+from ytklearn_tpu.config import knobs
+
+
+def run(src, path="ytklearn_tpu/x.py", select=None):
+    return lint_source(textwrap.dedent(src), path, select)
+
+
+def rules_hit(src, path="ytklearn_tpu/x.py"):
+    return {f.rule for f in run(src, path)}
+
+
+def test_rule_catalog_is_the_issue_catalog():
+    assert set(RULES) == {
+        "host-sync-in-jit",
+        "retrace-hazard",
+        "undeclared-knob",
+        "broad-except-swallow",
+        "bare-print",
+        "serve-lock-discipline",
+    }
+    for r in RULES.values():
+        assert r.doc  # every rule documents itself for --list-rules
+
+
+# ---------------------------------------------------------------------------
+# host-sync-in-jit
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "body",
+    [
+        "return x.item()",
+        "return x.tolist()",
+        "return float(x) * 2",
+        "return np.asarray(x).sum()",
+        "return jax.device_get(x)",
+        "if x > 0:\n            return x\n        return -x",
+    ],
+)
+def test_host_sync_in_jit_fails(body):
+    src = f"""\
+    import jax
+    import numpy as np
+
+    @jax.jit
+    def f(x):
+        {body}
+    """
+    assert "host-sync-in-jit" in rules_hit(src)
+
+
+def test_host_sync_catches_functions_passed_to_jit_and_shard_map():
+    src = """\
+    import jax
+
+    def f(x):
+        return x.item()
+
+    g = jax.jit(f)
+
+    def k(x):
+        return float(x)
+
+    out = shard_map(k, mesh, in_specs=None, out_specs=None)
+    """
+    found = run(src)
+    assert {f.rule for f in found} == {"host-sync-in-jit"}
+    assert len(found) == 2
+
+
+def test_host_sync_passes():
+    src = """\
+    import jax
+    from functools import partial
+
+    @partial(jax.jit, static_argnames=("n",))
+    def f(x, n):
+        return x * float(n)  # static arg: a real python value
+
+    def host_side(x):
+        return x.item()  # not traced — host code may sync freely
+    """
+    assert run(src) == []
+
+
+def test_host_sync_suppression():
+    src = """\
+    import jax
+
+    @jax.jit
+    def f(x):
+        # ytklint: allow(host-sync-in-jit) reason=fixture demonstrating suppression
+        return x.item()
+    """
+    assert run(src) == []
+    # same-line form
+    src2 = """\
+    import jax
+
+    @jax.jit
+    def f(x):
+        return x.item()  # ytklint: allow(host-sync-in-jit) reason=demo
+    """
+    assert run(src2) == []
+
+
+# ---------------------------------------------------------------------------
+# retrace-hazard
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "body",
+    [
+        "return x * time.time()",
+        "return x * random.random()",
+        "return x * np.random.rand()",
+        "s = 0\n        for k, v in d.items():\n            s = s + v\n        return x + s",
+        "return x * knobs.get_float('YTK_HEALTH_INGEST_TOL')",
+        "return x * float(os.environ.get('N', 1))",
+    ],
+)
+def test_retrace_hazard_fails(body):
+    src = f"""\
+    import jax, time, random, os
+    import numpy as np
+    from ytklearn_tpu.config import knobs
+
+    d = {{}}
+
+    @jax.jit
+    def f(x):
+        {body}
+    """
+    assert "retrace-hazard" in rules_hit(src)
+
+
+def test_retrace_hazard_mutable_default_fails():
+    src = """\
+    import jax
+
+    @jax.jit
+    def f(x, opts=[]):
+        return x
+    """
+    assert "retrace-hazard" in rules_hit(src)
+
+
+def test_retrace_hazard_passes():
+    src = """\
+    import jax, time
+
+    @jax.jit
+    def f(x, key, d):
+        s = x
+        for k, v in sorted(d.items()):  # deterministic trace order
+            s = s + v
+        return s + jax.random.uniform(key)  # device RNG is fine
+
+    def host(x):
+        return time.time(), x  # untraced host timing is fine
+    """
+    assert run(src) == []
+
+
+# ---------------------------------------------------------------------------
+# undeclared-knob
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "line",
+    [
+        "v = os.environ.get('YTK_FOO')",
+        "v = os.environ['YTK_FOO']",
+        "v = os.getenv('YTK_FOO')",
+        "v = knobs.get_str('YTK_NOT_A_REAL_KNOB')",
+    ],
+)
+def test_undeclared_knob_fails(line):
+    src = f"""\
+    import os
+    from ytklearn_tpu.config import knobs
+
+    {line}
+    """
+    assert "undeclared-knob" in rules_hit(src)
+
+
+def test_undeclared_knob_passes():
+    src = """\
+    import os
+    from ytklearn_tpu.config import knobs
+
+    a = knobs.get_bool("YTK_HEALTH")  # declared accessor read
+    b = os.environ.get("JAX_PLATFORMS")  # non-YTK envs are out of scope
+    os.environ["YTK_HEALTH"] = "0"  # writes (test setup) are allowed
+    """
+    assert run(src) == []
+    # the registry module itself is the one sanctioned reader
+    raw = 'import os\nv = os.environ.get("YTK_HEALTH")\n'
+    assert lint_source(raw, "ytklearn_tpu/config/knobs.py") == []
+
+
+# ---------------------------------------------------------------------------
+# broad-except-swallow
+# ---------------------------------------------------------------------------
+
+
+def test_broad_except_fails():
+    src = """\
+    try:
+        work()
+    except Exception:
+        pass
+    """
+    assert "broad-except-swallow" in rules_hit(src)
+    src_bare = """\
+    try:
+        work()
+    except:
+        result = None
+    """
+    assert "broad-except-swallow" in rules_hit(src_bare)
+
+
+@pytest.mark.parametrize(
+    "handler",
+    [
+        "except ValueError:\n    pass",  # narrow type
+        "except Exception:\n    log.warning('failed')",  # logs
+        "except Exception:\n    raise RuntimeError('wrapped')",  # re-raises
+        "except Exception as e:\n    results.append(e)",  # propagates it
+    ],
+)
+def test_broad_except_passes(handler):
+    src = f"try:\n    work()\n{handler}\n"
+    assert run(src) == []
+
+
+def test_broad_except_suppression_uses_issue_alias():
+    src = """\
+    try:
+        work()
+    # ytklint: allow(broad-except) reason=best-effort cleanup must not mask the original error
+    except Exception:
+        pass
+    """
+    assert run(src) == []
+
+
+# ---------------------------------------------------------------------------
+# bare-print
+# ---------------------------------------------------------------------------
+
+
+def test_bare_print_fails_in_library():
+    assert "bare-print" in rules_hit("print('hi')\n")
+
+
+def test_bare_print_allowlists_cli_and_ignores_scripts():
+    assert lint_source("print('{}')\n", "ytklearn_tpu/cli.py") == []
+    assert lint_source("print('report')\n", "scripts/report.py") == []
+
+
+def test_bare_print_suppression():
+    src = "print('x')  # ytklint: allow(bare-print) reason=fixture\n"
+    assert run(src) == []
+
+
+# ---------------------------------------------------------------------------
+# serve-lock-discipline
+# ---------------------------------------------------------------------------
+
+_LOCKED_CLASS = """\
+import threading
+
+class Q:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.depth = 0  # __init__ publishes before threads exist
+
+    def push(self):
+        with self._lock:
+            self.depth += 1
+
+    def reset(self):
+        {reset_body}
+"""
+
+
+def test_serve_lock_discipline_fails():
+    src = _LOCKED_CLASS.format(reset_body="self.depth = 0  # no lock!")
+    found = lint_source(src, "ytklearn_tpu/serve/q.py")
+    assert {f.rule for f in found} == {"serve-lock-discipline"}
+
+
+def test_serve_lock_discipline_passes_under_lock():
+    src = _LOCKED_CLASS.format(
+        reset_body="with self._lock:\n            self.depth = 0"
+    )
+    assert lint_source(src, "ytklearn_tpu/serve/q.py") == []
+
+
+def test_serve_lock_discipline_scoped_to_serve():
+    src = _LOCKED_CLASS.format(reset_body="self.depth = 0")
+    assert lint_source(src, "ytklearn_tpu/gbdt/q.py") == []
+
+
+# ---------------------------------------------------------------------------
+# suppression hygiene
+# ---------------------------------------------------------------------------
+
+
+def test_suppression_without_reason_is_itself_a_finding():
+    src = "print('x')  # ytklint: allow(bare-print)\n"
+    found = run(src)
+    assert {f.rule for f in found} == {"bare-print", "bad-suppression"}
+
+
+def test_suppression_with_unknown_rule_is_flagged():
+    src = "x = 1  # ytklint: allow(no-such-rule) reason=typo\n"
+    assert {f.rule for f in run(src)} == {"bad-suppression"}
+
+
+def test_suppression_only_covers_named_rule():
+    src = """\
+    import jax, time
+
+    @jax.jit
+    def f(x):
+        return x.item() * time.time()  # ytklint: allow(host-sync-in-jit) reason=fixture
+    """
+    assert {f.rule for f in run(src)} == {"retrace-hazard"}
+
+
+# ---------------------------------------------------------------------------
+# the gate: the repo itself is clean, and the knob docs are in sync
+# ---------------------------------------------------------------------------
+
+
+def test_repo_is_ytklint_clean(monkeypatch):
+    import pathlib
+
+    monkeypatch.chdir(pathlib.Path(__file__).resolve().parent.parent)
+    found = lint_paths(["ytklearn_tpu", "scripts", "bench.py"])
+    assert found == [], "\n".join(str(f) for f in found)
+
+
+def test_knob_doc_sync_both_ways(tmp_path, monkeypatch):
+    import pathlib
+
+    monkeypatch.chdir(pathlib.Path(__file__).resolve().parent.parent)
+    assert knobs.check_doc_sync("docs/running_guide.md") == []
+    # a missing declared knob AND an undocumented extra both fail
+    table = knobs.table_markdown()
+    tampered = table.replace("| `YTK_HEALTH` |", "| `YTK_IMAGINARY` |")
+    doc = tmp_path / "guide.md"
+    doc.write_text(f"# guide\n\n{tampered}\n")
+    problems = knobs.check_doc_sync(str(doc))
+    assert any("YTK_HEALTH" in p for p in problems)  # declared, not documented
+    assert any("YTK_IMAGINARY" in p for p in problems)  # documented, undeclared
+
+
+def test_knob_accessors(monkeypatch):
+    with pytest.raises(KeyError):
+        knobs.get_str("YTK_NOT_DECLARED_ANYWHERE")
+    assert knobs.get_int("YTK_FLIGHT_N") == 4096
+    assert knobs.get_bool("YTK_HEALTH") is True
+    monkeypatch.setenv("YTK_HEALTH", "off")
+    assert knobs.get_bool("YTK_HEALTH") is False
+    # an empty export means "cleared", not "off": default-on knobs stay on
+    monkeypatch.setenv("YTK_HEALTH", "")
+    assert knobs.get_bool("YTK_HEALTH") is True
+    assert knobs.get_float("YTK_SERVE_WATCH_S") == 5.0
+    assert knobs.get_raw("YTK_OBS") is None
+
+
+def test_lint_paths_relativizes_absolute_repo_paths(tmp_path):
+    # path-scoped rules must fire when the caller passes absolute paths —
+    # a violating file reached via /abs/path/to/repo/ytklearn_tpu/... must
+    # still hit the library-scoped bare-print rule
+    import pathlib
+
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    target = repo / "ytklearn_tpu" / "_ytklint_abs_path_fixture.py"
+    target.write_text("print('x')\n")
+    try:
+        found = lint_paths([str(target)])
+    finally:
+        target.unlink()
+    assert [f.rule for f in found] == ["bare-print"]
+    assert found[0].path == "ytklearn_tpu/_ytklint_abs_path_fixture.py"
+    # ...while a file OUTSIDE the repo keeps its own path and stays out of
+    # the library-scoped rule
+    outside = tmp_path / "bare.py"
+    outside.write_text("print('x')\n")
+    assert lint_paths([str(outside)]) == []
+
+
+def test_lint_paths_refuses_zero_file_runs(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        lint_paths(["no_such_dir_anywhere"])
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    with pytest.raises(FileNotFoundError):
+        lint_paths([str(empty)])

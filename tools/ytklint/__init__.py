@@ -1,0 +1,25 @@
+"""ytklint — project-specific, JAX/TPU-aware static analysis.
+
+The generic linters the ecosystem ships cannot see this repo's real
+hazards: a hidden host sync inside a jitted hot path, retrace bait in a
+traced closure, an undeclared YTK_* knob, a broad except that swallows a
+failure, a serve-class attribute mutated outside its lock. ytklint is a
+small AST framework (core.py) plus six rules (rules.py) that encode
+exactly those invariants, with an inline suppression syntax:
+
+    # ytklint: allow(<rule>[, <rule>]) reason=<non-empty explanation>
+
+on the offending line or a comment line directly above it. Entry point:
+``python -m tools.ytklint <paths>`` or ``scripts/check_lint.sh`` (which
+also runs the knob-registry doc-sync check). Rule catalog + how to add a
+rule: docs/static_analysis.md.
+"""
+
+from .core import (  # noqa: F401
+    Finding,
+    RULES,
+    lint_paths,
+    lint_source,
+    main,
+)
+from . import rules  # noqa: F401  — importing registers the rule set

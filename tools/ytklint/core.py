@@ -1,0 +1,241 @@
+"""ytklint framework: rule registry, suppression parsing, file runner.
+
+A rule is a function ``check(ctx: FileContext) -> Iterable[(line, msg)]``
+registered with the ``@rule(name, doc, applies=...)`` decorator. The
+runner parses each file once, hands every applicable rule the shared
+``FileContext`` (AST + raw lines + suppression map), filters findings
+through the suppression map, and reports malformed suppressions
+(missing/empty ``reason=``, unknown rule names) as findings themselves so
+a typo can never silently disable a check.
+
+Suppression grammar (same line as the finding, or a comment line
+immediately above it):
+
+    # ytklint: allow(rule-a, rule-b) reason=why this is safe here
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+import sys
+from dataclasses import dataclass
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Set
+
+SUPPRESS_RE = re.compile(
+    r"#\s*ytklint:\s*allow\(\s*([a-z0-9_, -]*?)\s*\)\s*(?:reason=(.*))?$"
+)
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    rule: str
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+@dataclass(frozen=True)
+class Rule:
+    name: str
+    doc: str
+    check: Callable
+    applies: Callable[[str], bool]
+
+
+RULES: Dict[str, Rule] = {}
+
+# short spellings accepted in allow() comments
+RULE_ALIASES = {"broad-except": "broad-except-swallow"}
+
+
+def _applies_everywhere(path: str) -> bool:
+    return True
+
+
+def rule(name: str, doc: str, applies: Optional[Callable] = None):
+    """Register a rule. `applies(relpath)` scopes it to part of the tree."""
+
+    def deco(fn):
+        if name in RULES:
+            raise ValueError(f"duplicate rule {name!r}")
+        RULES[name] = Rule(name, doc, fn, applies or _applies_everywhere)
+        return fn
+
+    return deco
+
+
+class FileContext:
+    """One parsed file: AST, raw lines, and the suppression map."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path.replace("\\", "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, path)
+        # line -> set of rule names allowed there
+        self.allows: Dict[int, Set[str]] = {}
+        self.bad_suppressions: List[Finding] = []
+        self._parse_suppressions()
+
+    def _parse_suppressions(self) -> None:
+        for i, raw in enumerate(self.lines, start=1):
+            if "ytklint" not in raw:
+                continue
+            m = SUPPRESS_RE.search(raw)
+            if m is None:
+                if re.search(r"#\s*ytklint\s*:", raw):
+                    self.bad_suppressions.append(Finding(
+                        self.path, i, "bad-suppression",
+                        "malformed ytklint comment — expected "
+                        "`# ytklint: allow(<rule>) reason=...`",
+                    ))
+                continue
+            names = {
+                RULE_ALIASES.get(n.strip(), n.strip())
+                for n in m.group(1).split(",")
+                if n.strip()
+            }
+            reason = (m.group(2) or "").strip()
+            if not names or not reason:
+                self.bad_suppressions.append(Finding(
+                    self.path, i, "bad-suppression",
+                    "suppression needs at least one rule name and a "
+                    "non-empty reason=",
+                ))
+                continue
+            unknown = sorted(n for n in names if n not in RULES)
+            if unknown:
+                self.bad_suppressions.append(Finding(
+                    self.path, i, "bad-suppression",
+                    f"unknown rule name(s) in allow(): {', '.join(unknown)}",
+                ))
+                names -= set(unknown)
+            targets = [i]
+            # a comment-only line suppresses the statement below it
+            if raw.strip().startswith("#"):
+                targets.append(i + 1)
+            for t in targets:
+                self.allows.setdefault(t, set()).update(names)
+
+    def allowed(self, rule_name: str, line: int) -> bool:
+        return rule_name in self.allows.get(line, ())
+
+
+def lint_source(
+    source: str, path: str, select: Optional[Sequence[str]] = None
+) -> List[Finding]:
+    """Lint one source string under a (virtual) repo-relative path."""
+    try:
+        ctx = FileContext(path, source)
+    except SyntaxError as e:
+        return [Finding(path, e.lineno or 1, "syntax-error", str(e.msg))]
+    findings: List[Finding] = list(ctx.bad_suppressions)
+    for r in RULES.values():
+        if select and r.name not in select:
+            continue
+        if not r.applies(ctx.path):
+            continue
+        for line, msg in r.check(ctx):
+            if not ctx.allowed(r.name, line):
+                findings.append(Finding(ctx.path, line, r.name, msg))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+# path-scoped rules (bare-print, serve-lock-discipline) match repo-relative
+# prefixes, so every linted file is relativized against this checkout —
+# absolute-path invocations must not silently skip scoped rules
+_REPO_ROOT = pathlib.Path(__file__).resolve().parents[2]
+
+
+def _rel(path: pathlib.Path) -> str:
+    try:
+        return path.resolve().relative_to(_REPO_ROOT).as_posix()
+    except ValueError:
+        return path.as_posix()
+
+
+def _iter_py_files(paths: Sequence[str]) -> Iterable[pathlib.Path]:
+    for p in paths:
+        path = pathlib.Path(p)
+        if path.is_dir():
+            yield from sorted(
+                f for f in path.rglob("*.py") if "__pycache__" not in f.parts
+            )
+        elif path.is_file() and path.suffix == ".py":
+            yield path
+        else:
+            raise FileNotFoundError(
+                f"ytklint: {p!r} is neither a directory nor a .py file — "
+                "a typoed target must not pass as a 0-file green run"
+            )
+
+
+def lint_paths(
+    paths: Sequence[str], select: Optional[Sequence[str]] = None
+) -> List[Finding]:
+    findings: List[Finding] = []
+    n_files = 0
+    for f in _iter_py_files(paths):
+        n_files += 1
+        findings.extend(
+            lint_source(f.read_text(encoding="utf-8"), _rel(f), select)
+        )
+    if n_files == 0:
+        raise FileNotFoundError(
+            f"ytklint: no .py files under {list(paths)!r}"
+        )
+    return findings
+
+
+DEFAULT_PATHS = ("ytklearn_tpu", "scripts", "bench.py")
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="ytklint",
+        description="JAX/TPU-aware project lint (docs/static_analysis.md)",
+    )
+    ap.add_argument("paths", nargs="*", default=None,
+                    help=f"files/dirs to lint (default: {' '.join(DEFAULT_PATHS)})")
+    ap.add_argument("--select", action="append", default=None,
+                    metavar="RULE", help="run only these rules (repeatable)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for r in RULES.values():
+            print(f"{r.name:24s} {r.doc}")
+        return 0
+    if args.select:
+        unknown = [s for s in args.select if s not in RULES]
+        if unknown:
+            print(f"ytklint: unknown rule(s): {', '.join(unknown)}",
+                  file=sys.stderr)
+            return 2
+    paths = args.paths or list(DEFAULT_PATHS)
+    try:
+        findings = lint_paths(paths, args.select)
+    except FileNotFoundError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    for f in findings:
+        print(str(f), file=sys.stderr)
+    n_rules = len(args.select) if args.select else len(RULES)
+    if findings:
+        print(
+            f"ytklint: {len(findings)} finding(s) across {n_rules} rule(s) — "
+            "fix, or suppress with `# ytklint: allow(<rule>) reason=...`",
+            file=sys.stderr,
+        )
+        return 1
+    print(f"ytklint: OK ({n_rules} rules)", file=sys.stderr)
+    return 0

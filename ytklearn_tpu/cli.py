@@ -135,14 +135,15 @@ def train_main(argv: Optional[List[str]] = None) -> int:
     _setup_logging(args.verbose)
     _setup_trace(args.trace_out)
 
-    import os as _os
+    from .config import knobs
 
-    if _os.environ.get("YTK_PLATFORM"):
+    platform = knobs.get_str("YTK_PLATFORM")
+    if platform:
         # explicit platform pin that works even when a sitecustomize
         # pre-imported jax and already captured JAX_PLATFORMS
         import jax
 
-        jax.config.update("jax_platforms", _os.environ["YTK_PLATFORM"])
+        jax.config.update("jax_platforms", platform)
     # multi-host rendezvous BEFORE any backend touch (the CommMaster
     # equivalent; reference: bin/cluster_optimizer.sh slave fan-out).
     # Without --coordinator this is a no-op unless YTKLEARN_TPU_DISTRIBUTED=1

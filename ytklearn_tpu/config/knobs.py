@@ -1,0 +1,333 @@
+"""Central registry of every ``YTK_*`` environment knob.
+
+Before this module each subsystem read ``os.environ`` directly, so the
+set of runtime knobs was only discoverable by grepping and half of them
+never reached docs/running_guide.md. Now every knob is *declared* here —
+name, type, default, one-line doc — and every read goes through the typed
+accessors below. The ytklint ``undeclared-knob`` rule forbids YTK_*
+``os.environ`` reads anywhere else in the tree, and ``check_doc_sync``
+asserts this registry and the running-guide knob table match both ways
+(scripts/check_lint.sh runs both on every change).
+
+Accessors re-read ``os.environ`` on every call: tests and operators set
+knobs at runtime and the previous call sites were all live reads too.
+The handful of knobs consumed by shell launchers (bin/*.sh) are declared
+with ``scope="shell"`` so the doc table stays the one complete inventory.
+
+Regenerate the running-guide table after editing declarations:
+
+    python -m ytklearn_tpu.config.knobs regen docs/running_guide.md
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+__all__ = [
+    "Knob",
+    "KNOBS",
+    "get_raw",
+    "get_str",
+    "get_int",
+    "get_float",
+    "get_bool",
+    "names",
+    "table_markdown",
+    "check_doc_sync",
+    "sync_doc",
+]
+
+
+@dataclass(frozen=True)
+class Knob:
+    name: str
+    type: str  # "str" | "int" | "float" | "bool"
+    default: object  # parsed value returned when the env var is unset
+    doc: str  # one line; becomes the running-guide table row
+    scope: str = "lib"  # "lib" | "bench" | "shell" (bin/*.sh) | "test"
+
+
+KNOBS: Dict[str, Knob] = {}
+
+
+def _knob(name: str, type_: str, default, doc: str, scope: str = "lib") -> None:
+    if name in KNOBS:
+        raise ValueError(f"duplicate knob declaration: {name}")
+    KNOBS[name] = Knob(name, type_, default, doc, scope)
+
+
+# -- platform / launcher ----------------------------------------------------
+_knob("YTK_PLATFORM", "str", None,
+      "force the JAX platform (e.g. `cpu`), even when a sitecustomize "
+      "pre-imported jax and already captured JAX_PLATFORMS")
+_knob("YTK_MASTER_LOG", "str", "log/master.log",
+      "merged rank-labeled master-log path for `bin/cluster_optimizer.sh`",
+      scope="shell")
+_knob("YTK_SLAVE_HOSTS", "str", None,
+      "space-separated hosts for ranks 1..N-1 (`bin/cluster_optimizer.sh` "
+      "ssh fan-out; unset = all ranks fork locally)", scope="shell")
+_knob("YTK_COORDINATOR_HOST", "str", "127.0.0.1",
+      "jax.distributed coordinator host for multi-host launches",
+      scope="shell")
+_knob("YTK_COORDINATOR_PORT", "int", 29401,
+      "jax.distributed coordinator port", scope="shell")
+
+# -- ingest -----------------------------------------------------------------
+_knob("YTK_NO_NATIVE", "bool", False,
+      "disable the native C++ libsvm parser (python fallback)")
+_knob("YTK_SKETCH_ROWS", "int", 1 << 25,
+      "rows above which quantile binning streams through the GK sketch "
+      "instead of the full-sort path")
+
+# -- convex training (blocked evaluation) -----------------------------------
+_knob("YTK_ROW_CHUNK", "int", None,
+      "fixed row-chunk override for blocked convex evaluation "
+      "(see [models.md](models.md) \"Memory\")")
+_knob("YTK_CHUNK_BUDGET_MB", "int", 1024,
+      "score-intermediate memory budget that sizes the automatic row chunk")
+
+# -- gbdt engine ------------------------------------------------------------
+_knob("YTK_PARTITION", "bool", True,
+      "leaf-partitioned GBDT histogram phases (default on since r6; "
+      "`0` turns them off)")
+_knob("YTK_NO_PARTITION", "bool", False,
+      "hard-disable leaf-partitioned histograms everywhere "
+      "(wins over `YTK_PARTITION`)")
+_knob("YTK_PARTITION_STRICT", "bool", False,
+      "fail loud instead of downgrading when a partitioned/fused round "
+      "program fails to compile (equivalence runs)")
+_knob("YTK_LADDER", "str", None,
+      "comma-separated budget-ladder divisors for partitioned histogram "
+      "passes (default: `64,256` fused on TPU, `8,32` on CPU)")
+_knob("YTK_FUSED", "bool", True,
+      "fused compact+gather+histogram Pallas kernel for partitioned "
+      "passes (`0` falls back to XLA gather)")
+_knob("YTK_FUSED_MAX_ROWS", "int", 1 << 18,
+      "max gathered rows per fused-kernel call (VMEM sizing)")
+_knob("YTK_PROFILE_DIR", "str", None,
+      "write a jax.profiler trace of the training loop for xprof")
+
+# -- observability ----------------------------------------------------------
+_knob("YTK_OBS", "str", None,
+      "`1` enables obs collection without export; `0` force-disables "
+      "(wins over the trace-path knobs)")
+_knob("YTK_OBS_JAX", "bool", False,
+      "wrap obs spans in jax.profiler.TraceAnnotation so they show up "
+      "inside XLA/xprof traces")
+_knob("YTK_TRACE", "str", None,
+      "enable obs + write a Chrome-trace/Perfetto JSON to this path at exit")
+_knob("YTK_TRACE_JSONL", "str", None,
+      "enable obs + write the JSONL event stream to this path at exit")
+
+# -- run health -------------------------------------------------------------
+_knob("YTK_HEALTH", "bool", True,
+      "run-health sentinels (NaN/divergence/ingest-rate); `0` reduces every "
+      "check to one attribute load")
+_knob("YTK_HEALTH_STRICT", "bool", False,
+      "escalate sentinel hits to HealthError naming the flight dump "
+      "(unattended production runs)")
+_knob("YTK_HEALTH_INGEST_TOL", "float", 0.01,
+      "ingest error-rate threshold (fraction) for the parse sentinel")
+_knob("YTK_FLIGHT", "bool", True,
+      "flight-recorder auto-install in trainers; `0` opts out")
+_knob("YTK_FLIGHT_N", "int", 4096,
+      "flight-recorder event-ring capacity")
+_knob("YTK_FLIGHT_DIR", "str", None,
+      "flight-dump directory (default: current directory)")
+
+# -- serving ----------------------------------------------------------------
+_knob("YTK_SERVE_LADDER", "str", None,
+      "serving batch-shape ladder, e.g. `1,8,64,512` "
+      "(see [serving.md](serving.md))")
+_knob("YTK_SERVE_WATCH_S", "float", 5.0,
+      "serving hot-reload fingerprint poll interval in seconds "
+      "(`0` disables the watcher)")
+
+# -- bench ------------------------------------------------------------------
+_knob("YTK_CHIP", "str", "v5e",
+      "chip key for bench roofline peaks (MXU/HBM utilization fields)",
+      scope="bench")
+_knob("YTK_HIGGS_DIR", "str", None,
+      "directory holding the real Higgs split for bench.py "
+      "(default: `experiment/higgs/`)", scope="bench")
+_knob("YTK_REF", "str", "/root/reference",
+      "path to the reference checkout used by reference-gated tests and "
+      "benches", scope="test")
+
+
+# ---------------------------------------------------------------------------
+# Typed accessors — the only sanctioned YTK_* environ reads in the tree.
+# ---------------------------------------------------------------------------
+
+_FALSY = ("0", "false", "no", "off")
+
+
+def _declared(name: str) -> Knob:
+    try:
+        return KNOBS[name]
+    except KeyError:
+        raise KeyError(
+            f"undeclared knob {name!r}: declare it in "
+            "ytklearn_tpu/config/knobs.py (the ytklint undeclared-knob "
+            "rule enforces this statically too)"
+        ) from None
+
+
+def get_raw(name: str) -> Optional[str]:
+    """The raw env string, or None when unset (tri-state knobs: YTK_OBS)."""
+    _declared(name)
+    return os.environ.get(name)
+
+
+def get_str(name: str) -> Optional[str]:
+    knob = _declared(name)
+    raw = os.environ.get(name)
+    return raw if raw not in (None, "") else knob.default
+
+
+def get_int(name: str) -> Optional[int]:
+    knob = _declared(name)
+    raw = os.environ.get(name)
+    return int(raw) if raw not in (None, "") else knob.default
+
+
+def get_float(name: str) -> Optional[float]:
+    knob = _declared(name)
+    raw = os.environ.get(name)
+    return float(raw) if raw not in (None, "") else knob.default
+
+
+def get_bool(name: str) -> bool:
+    """Unset or empty -> declared default (an empty export is "cleared",
+    same as the str/int/float accessors); `0`/`false`/`no`/`off` (any
+    case) -> False; anything else -> True."""
+    knob = _declared(name)
+    raw = os.environ.get(name)
+    if raw is None or raw.strip() == "":
+        return bool(knob.default)
+    return raw.strip().lower() not in _FALSY
+
+
+def names() -> list:
+    return sorted(KNOBS)
+
+
+# ---------------------------------------------------------------------------
+# Doc sync: the running-guide knob table is generated from this registry.
+# ---------------------------------------------------------------------------
+
+DOC_BEGIN = "<!-- knob-table:begin -->"
+DOC_END = "<!-- knob-table:end -->"
+_NAME_RE = re.compile(r"`(YTK_[A-Z0-9_]+)")
+
+
+def _fmt_default(knob: Knob) -> str:
+    if knob.default is None:
+        return "unset"
+    if knob.type == "bool":
+        return "on" if knob.default else "off"
+    return f"`{knob.default}`"
+
+
+def table_markdown() -> str:
+    """The complete knob table as a markdown block (with sync markers)."""
+    lines = [DOC_BEGIN, "| knob | default | effect |", "|---|---|---|"]
+    for name in sorted(KNOBS):
+        knob = KNOBS[name]
+        suffix = {"shell": " *(shell launchers)*", "bench": " *(bench.py)*",
+                  "test": " *(tests)*"}.get(knob.scope, "")
+        lines.append(f"| `{name}` | {_fmt_default(knob)} | {knob.doc}{suffix} |")
+    lines.append(DOC_END)
+    return "\n".join(lines)
+
+
+def _doc_block(text: str, path: str) -> str:
+    try:
+        start = text.index(DOC_BEGIN)
+        end = text.index(DOC_END)
+    except ValueError:
+        raise ValueError(
+            f"{path}: knob-table markers not found — the knob table must "
+            f"live between {DOC_BEGIN.split(' ')[0]}… and {DOC_END}"
+        ) from None
+    return text[start:end]
+
+
+def check_doc_sync(doc_path: str = "docs/running_guide.md") -> list:
+    """Both-way registry<->doc check; returns a list of problem strings
+    (empty = in sync). Every declared knob must appear in the doc table,
+    and every YTK_* name in the table must be declared here."""
+    with open(doc_path, encoding="utf-8") as f:
+        text = f.read()
+    block = _doc_block(text, doc_path)
+    documented = set(_NAME_RE.findall(block))
+    declared = set(KNOBS)
+    problems = []
+    for name in sorted(declared - documented):
+        problems.append(
+            f"{doc_path}: knob {name} is declared in the registry but "
+            "missing from the knob table (regen the table)"
+        )
+    for name in sorted(documented - declared):
+        problems.append(
+            f"{doc_path}: knob {name} appears in the knob table but is not "
+            "declared in ytklearn_tpu/config/knobs.py"
+        )
+    if block.strip() != table_markdown().replace(DOC_END, "").strip():
+        if not problems:
+            problems.append(
+                f"{doc_path}: knob table text drifted from the registry "
+                "(regen the table)"
+            )
+    return problems
+
+
+def sync_doc(doc_path: str = "docs/running_guide.md") -> bool:
+    """Rewrite the doc's knob-table block from the registry. True = changed."""
+    with open(doc_path, encoding="utf-8") as f:
+        text = f.read()
+    _doc_block(text, doc_path)  # raises when markers are missing
+    start = text.index(DOC_BEGIN)
+    end = text.index(DOC_END) + len(DOC_END)
+    new = text[:start] + table_markdown() + text[end:]
+    if new == text:
+        return False
+    with open(doc_path, "w", encoding="utf-8") as f:
+        f.write(new)
+    return True
+
+
+def _main(argv) -> int:
+    import sys
+
+    if not argv or argv[0] not in ("table", "check", "regen"):
+        sys.stderr.write(
+            "usage: python -m ytklearn_tpu.config.knobs "
+            "{table | check [doc] | regen [doc]}\n"
+        )
+        return 2
+    cmd, rest = argv[0], argv[1:]
+    doc = rest[0] if rest else "docs/running_guide.md"
+    if cmd == "table":
+        sys.stdout.write(table_markdown() + "\n")
+        return 0
+    if cmd == "regen":
+        changed = sync_doc(doc)
+        sys.stderr.write(f"{doc}: {'rewrote' if changed else 'unchanged'}\n")
+        return 0
+    problems = check_doc_sync(doc)
+    for p in problems:
+        sys.stderr.write(p + "\n")
+    if problems:
+        return 1
+    sys.stderr.write(f"knob doc sync: OK ({len(KNOBS)} knobs)\n")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(_main(sys.argv[1:]))

@@ -28,18 +28,18 @@ from __future__ import annotations
 
 import fnmatch
 import math
-import os
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..config import knobs
 from ..config.params import ApproximateSpec, GBDTParams
 
 # Columns longer than this stream through the weighted GK sketch instead
 # of the full-sort quantile path (sort+cumsum temporaries cost ~4x the
 # column; the sketch is O(b log(n/chunk))). Override: YTK_SKETCH_ROWS.
-SKETCH_ROWS = int(os.environ.get("YTK_SKETCH_ROWS", str(1 << 25)))
+SKETCH_ROWS = knobs.get_int("YTK_SKETCH_ROWS")
 
 
 @dataclass

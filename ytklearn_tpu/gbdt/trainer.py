@@ -33,7 +33,6 @@ from __future__ import annotations
 
 import logging
 import math
-import os
 import time
 from dataclasses import dataclass, field
 from functools import partial
@@ -43,6 +42,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..config import knobs
 from ..config.params import GBDTParams
 from ..eval import EvalSet
 from ..io.fs import FileSystem, LocalFileSystem
@@ -324,8 +324,8 @@ class GBDTTrainer:
         # turns it off, so an A/B "off" run can never silently run
         # partitioned; YTK_PARTITION=1 stays accepted (now a no-op).
         partition = (
-            os.environ.get("YTK_NO_PARTITION") != "1"
-            and os.environ.get("YTK_PARTITION") != "0"
+            not knobs.get_bool("YTK_NO_PARTITION")
+            and knobs.get_bool("YTK_PARTITION")
         )
         # budget ladder divisors: the TPU default routes only genuinely
         # late waves (<= n/64 rows) into partitioned passes, all through
@@ -333,13 +333,13 @@ class GBDTTrainer:
         # net losers on TPU in r5 and stay off the default there. The CPU
         # dense path keeps the r5 ladder (gathers are cheap on CPU).
         # YTK_LADDER / YTK_FUSED / YTK_FUSED_MAX_ROWS override for tuning.
-        ladder_env = os.environ.get("YTK_LADDER")
+        ladder_env = knobs.get_str("YTK_LADDER")
         if ladder_env:
             ladder = tuple(int(x) for x in ladder_env.split(",") if x.strip())
         else:
             ladder = (8, 32) if force_dense else (64, 256)
-        fused = os.environ.get("YTK_FUSED", "1") != "0"
-        fused_max_rows = int(os.environ.get("YTK_FUSED_MAX_ROWS", str(1 << 18)))
+        fused = knobs.get_bool("YTK_FUSED")
+        fused_max_rows = knobs.get_int("YTK_FUSED_MAX_ROWS")
         return GrowSpec(
             F=F,
             B=B,
@@ -611,7 +611,7 @@ class GBDTTrainer:
         YTK_PARTITION_STRICT=1 keeps failures loud (equivalence runs)."""
         if (
             jax.default_backend() != "tpu"
-            or os.environ.get("YTK_PARTITION_STRICT") == "1"
+            or knobs.get_bool("YTK_PARTITION_STRICT")
         ):
             return jit_round, spec
         import dataclasses
@@ -745,7 +745,7 @@ class GBDTTrainer:
         # is an unexpected recompilation — a retrace storm shows up here
         # instead of as silently-tripled round times
         self._retrace = health.RetraceSentinel("gbdt.rounds")
-        profile_dir = os.environ.get("YTK_PROFILE_DIR")
+        profile_dir = knobs.get_str("YTK_PROFILE_DIR")
         if profile_dir:
             jax.profiler.start_trace(profile_dir)
         t_train0 = time.time()

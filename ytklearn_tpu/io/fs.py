@@ -121,8 +121,9 @@ class FsspecFileSystem(FileSystem):
             if parent and parent != path:
                 try:
                     self.fs.makedirs(parent, exist_ok=True)
+                # ytklint: allow(broad-except) reason=fsspec drivers raise driver-specific errors; flat namespaces need no parent dirs and open() surfaces real failures
                 except Exception:
-                    pass  # flat namespaces (memory/s3) don't need dirs
+                    pass
         return self.fs.open(path, mode)
 
     def mkdirs(self, path: str) -> None:

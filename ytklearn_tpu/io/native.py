@@ -26,6 +26,8 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
+from ..config import knobs
+
 log = logging.getLogger(__name__)
 
 _REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -49,7 +51,7 @@ def _build() -> bool:
     ]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-    except Exception as e:  # toolchain missing / compile error -> fallback
+    except (subprocess.SubprocessError, OSError) as e:  # toolchain missing / compile error -> fallback
         err = getattr(e, "stderr", b"")
         log.warning("native parser build failed (%s); using python parser: %s",
                     e, err.decode()[:500] if err else "")
@@ -67,7 +69,7 @@ def _load():
     with _lock:
         if _lib is not None or _lib_failed:
             return _lib
-        if os.environ.get("YTK_NO_NATIVE"):
+        if knobs.get_bool("YTK_NO_NATIVE"):
             _lib_failed = True
             return None
         try:

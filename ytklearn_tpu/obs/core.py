@@ -36,6 +36,8 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from ..config import knobs
+
 # process-level clock origin: span timestamps are seconds since import on
 # the monotonic clock (Chrome trace wants relative µs; JSONL carries the
 # wall origin in its meta line so events can be re-anchored)
@@ -171,7 +173,8 @@ class Span:
 
                 self._jax_ann = jax.profiler.TraceAnnotation(self.name)
                 self._jax_ann.__enter__()
-            except Exception:  # noqa: BLE001 — annotation is best-effort
+            # ytklint: allow(broad-except) reason=profiler annotation is best-effort decoration; a broken profiler must not fail the span
+            except Exception:
                 self._jax_ann = None
         REGISTRY._stack().append(self.name)
         self.t0 = _now()
@@ -184,13 +187,15 @@ class Span:
 
                 target = self._settle() if callable(self._settle) else self._settle
                 jax.block_until_ready(target)
-            except Exception:  # noqa: BLE001 — never let timing kill the run
+            # ytklint: allow(broad-except) reason=settle targets may be deleted/donated by exit time; timing must never kill the run
+            except Exception:
                 pass
         t1 = _now()
         if self._jax_ann is not None:
             try:
                 self._jax_ann.__exit__(exc_type, exc, tb)
-            except Exception:  # noqa: BLE001
+            # ytklint: allow(broad-except) reason=profiler exit is best-effort; the span event must still be recorded below
+            except Exception:
                 pass
         stack = REGISTRY._stack()
         if stack:
@@ -270,7 +275,8 @@ def _leaf_bytes(x) -> int:
     if shape is not None and dtype is not None:
         try:
             return int(math.prod(shape)) * int(dtype.itemsize)
-        except Exception:  # noqa: BLE001 — abstract dtypes without itemsize
+        # ytklint: allow(broad-except) reason=abstract/extended dtypes without itemsize count as 0 bytes in the census
+        except Exception:
             return 0
     if isinstance(x, dict):
         return sum(_leaf_bytes(v) for v in x.values())
@@ -361,14 +367,14 @@ def configure(
 
 
 def _configure_from_env() -> None:
-    flag = os.environ.get("YTK_OBS")
+    flag = knobs.get_raw("YTK_OBS")
     if flag == "0":  # force-off wins over everything
         return
-    trace = os.environ.get("YTK_TRACE") or None
-    jsonl = os.environ.get("YTK_TRACE_JSONL") or None
+    trace = knobs.get_str("YTK_TRACE")
+    jsonl = knobs.get_str("YTK_TRACE_JSONL")
     if trace or jsonl or flag == "1":
         configure(enabled=True, trace_path=trace, jsonl_path=jsonl)
-    if os.environ.get("YTK_OBS_JAX") == "1":
+    if knobs.get_bool("YTK_OBS_JAX"):
         _state.jax_annotations = True
 
 

@@ -54,6 +54,7 @@ import os
 from typing import Optional, Sequence
 
 from . import core, recorder
+from ..config import knobs
 
 log = logging.getLogger("ytklearn_tpu.obs.health")
 
@@ -75,9 +76,9 @@ class _HealthState:
     __slots__ = ("on", "strict", "ingest_tol")
 
     def __init__(self):
-        self.on = os.environ.get("YTK_HEALTH", "1") != "0"
+        self.on = knobs.get_bool("YTK_HEALTH")
         self.strict: Optional[bool] = None  # None -> read env per hit
-        self.ingest_tol = float(os.environ.get("YTK_HEALTH_INGEST_TOL", "0.01"))
+        self.ingest_tol = knobs.get_float("YTK_HEALTH_INGEST_TOL")
 
 
 _state = _HealthState()
@@ -104,7 +105,7 @@ def configure_health(
 def _strict() -> bool:
     if _state.strict is not None:
         return _state.strict
-    return os.environ.get("YTK_HEALTH_STRICT") == "1"
+    return knobs.get_bool("YTK_HEALTH_STRICT")
 
 
 def _fire(kind: str, site: str, msg: str, escalate: bool = True, **args) -> None:
@@ -262,7 +263,8 @@ def _host_rss_peak_bytes() -> Optional[float]:
         rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
         # linux reports KiB, macOS bytes
         return float(rss * 1024 if os.uname().sysname == "Linux" else rss)
-    except Exception:  # noqa: BLE001 — telemetry is best-effort
+    # ytklint: allow(broad-except) reason=memory telemetry is best-effort; platforms without the resource module just skip the gauge
+    except Exception:
         return None
 
 
@@ -277,7 +279,8 @@ def record_memory(phase: str) -> None:
         import jax
 
         stats = jax.local_devices()[0].memory_stats()
-    except Exception:  # noqa: BLE001
+    # ytklint: allow(broad-except) reason=backends without memory_stats() fall back to host RSS below
+    except Exception:
         stats = None
     if stats:
         peak = stats.get("peak_bytes_in_use")

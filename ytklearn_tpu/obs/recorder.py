@@ -46,6 +46,7 @@ from collections import deque
 from typing import Optional
 
 from . import core
+from ..config import knobs
 
 log = logging.getLogger("ytklearn_tpu.obs")
 
@@ -96,7 +97,8 @@ def set_config_fingerprint(obj) -> None:
 
     try:
         text = repr(obj)
-    except Exception:  # noqa: BLE001 — a broken repr must not kill training
+    # ytklint: allow(broad-except) reason=a broken user repr must not kill training; the fingerprint degrades to the type name
+    except Exception:
         text = f"<unrepresentable {type(obj).__name__}>"
     _state.config_fingerprint = {
         "type": type(obj).__name__,
@@ -106,7 +108,7 @@ def set_config_fingerprint(obj) -> None:
 
 
 def _flight_dir() -> str:
-    return _state.dir or os.environ.get("YTK_FLIGHT_DIR") or os.getcwd()
+    return _state.dir or knobs.get_str("YTK_FLIGHT_DIR") or os.getcwd()
 
 
 def _runtime_info() -> dict:
@@ -240,7 +242,7 @@ def _atexit_handler():
 def install(ring_n: Optional[int] = None, flight_dir: Optional[str] = None) -> None:
     """Install the ring + abnormal-exit hooks (idempotent)."""
     with _install_lock:
-        n = ring_n or int(os.environ.get("YTK_FLIGHT_N", DEFAULT_RING_N))
+        n = ring_n or knobs.get_int("YTK_FLIGHT_N")
         if flight_dir:
             _state.dir = flight_dir
         with core.REGISTRY._lock:
@@ -264,7 +266,7 @@ def auto_install() -> None:
     the no-op contract call sites rely on."""
     if not core.enabled():
         return
-    if os.environ.get("YTK_FLIGHT") == "0":
+    if not knobs.get_bool("YTK_FLIGHT"):
         return
     install()
 

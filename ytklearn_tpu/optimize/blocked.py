@@ -292,14 +292,14 @@ def suggest_chunk(
     and each 200-row test-mesh shard was padded to a 4096-row chunk —
     ~20x compute amplification per line-search trial (test_ffm_agaricus
     3088 s). Now: local_rows <= min_chunk -> None."""
-    import os
+    from ..config import knobs
 
     local_rows = -(-n_rows // max(n_shards, 1))
     if budget_bytes is None:
-        budget_bytes = int(os.environ.get("YTK_CHUNK_BUDGET_MB", "1024")) << 20
-    env = os.environ.get("YTK_ROW_CHUNK")
+        budget_bytes = knobs.get_int("YTK_CHUNK_BUDGET_MB") << 20
+    env = knobs.get_int("YTK_ROW_CHUNK")
     if env is not None:
-        chunk = int(env)
+        chunk = env
         return chunk if 0 < chunk < local_rows else None
     if local_rows <= min_chunk:
         return None

@@ -29,6 +29,7 @@ import threading
 import time
 from typing import Dict, Optional
 
+from ..config import knobs
 from ..obs import event as obs_event, gauge as obs_gauge, inc as obs_inc
 from ..predict import create_predictor
 from .scorer import CompiledScorer
@@ -93,7 +94,7 @@ class ModelRegistry:
     def __init__(self, ladder=None, watch_interval_s: Optional[float] = None):
         self.ladder = ladder
         if watch_interval_s is None:
-            watch_interval_s = float(os.environ.get("YTK_SERVE_WATCH_S", "5"))
+            watch_interval_s = knobs.get_float("YTK_SERVE_WATCH_S")
         self.watch_interval_s = watch_interval_s
         self._entries: Dict[str, _Entry] = {}
         self._lock = threading.Lock()

@@ -32,12 +32,12 @@ concept and not supported here.
 from __future__ import annotations
 
 import math
-import os
 import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..config import knobs
 from ..obs import health as obs_health
 from ..obs import inc as obs_inc, span as obs_span
 from ..predict.base import OnlinePredictor
@@ -73,7 +73,7 @@ class _LadderRetraceSentinel(obs_health.RetraceSentinel):
 def parse_ladder(spec: Optional[str] = None) -> Tuple[int, ...]:
     """YTK_SERVE_LADDER="1,8,64,512" -> sorted unique rung tuple."""
     if spec is None:
-        spec = os.environ.get("YTK_SERVE_LADDER", "")
+        spec = knobs.get_str("YTK_SERVE_LADDER") or ""
     if not spec:
         return DEFAULT_LADDER
     rungs = sorted({int(v) for v in str(spec).split(",") if v.strip()})
@@ -119,6 +119,9 @@ class CompiledScorer:
         compiles this causes are credited so scorers already armed (hot
         reload warms the replacement while the old one still serves) don't
         count them as steady-state retraces."""
+        import jax
+        import jax.numpy as jnp
+
         global _warmup_compile_credit, _warmups_in_progress
         before = obs_health.RetraceSentinel._compiles()
         _warmups_in_progress += 1
@@ -126,8 +129,8 @@ class CompiledScorer:
             with obs_span("serve.warmup", rungs=len(self.ladder)):
                 for rung in self.ladder:
                     X = np.full((rung, self.dim), self._fill, np.float64)
-                    s, p = self._jit(X)
-                    np.asarray(s), np.asarray(p)  # block: compile+execute now
+                    s, p = self._jit(jnp.asarray(X))
+                    jax.device_get((s, p))  # block: compile+execute now
                     obs_inc("serve.scorer.warmup_rungs")
         finally:
             # credit BEFORE dropping the in-progress flag, so once the flag
@@ -182,6 +185,12 @@ class CompiledScorer:
         return self.ladder[-1]
 
     def _run(self, rows) -> Tuple[np.ndarray, np.ndarray]:
+        # host<->device hops at the jit boundary are EXPLICIT (jnp.asarray
+        # in, device_get out): the --ytk-sanitize transfer guard proves the
+        # steady-state score path performs no hidden implicit transfer
+        import jax
+        import jax.numpy as jnp
+
         X = self.featurize(rows)
         B = X.shape[0]
         max_rung = self.ladder[-1]
@@ -198,9 +207,7 @@ class CompiledScorer:
                     [chunk, np.full((pad, self.dim), self._fill, np.float64)]
                 )
             with obs_span("serve.score", rung=rung, rows=rung - pad):
-                s, p = self._jit(chunk)
-                s = np.asarray(s)
-                p = np.asarray(p)
+                s, p = jax.device_get(self._jit(jnp.asarray(chunk)))
             obs_inc("serve.scorer.batches")
             obs_inc("serve.scorer.rows", rung - pad)
             obs_inc("serve.scorer.pad_rows", pad)
