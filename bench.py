@@ -39,9 +39,11 @@ BENCH_TREES=500 full run validates the extrapolation (docs/bench.md).
 A persistent compilation cache under .jax_cache makes repeat runs cheap.
 
 Env knobs: BENCH_ROWS, BENCH_TEST_ROWS, BENCH_TREES, BENCH_WAVE,
-BENCH_HIST (int8|bf16|f32), BENCH_FM=0 to skip the FM axis, YTK_HIGGS_DIR,
-YTK_CHIP (v5e|v5p|v4|v6e — peak table for utilization), plus the engine's
-YTK_PARTITION / YTK_LADDER / YTK_FUSED / YTK_FUSED_MAX_ROWS.
+BENCH_HIST (int8|bf16|f32), BENCH_GOSS (default on at a=0.2,b=0.125;
+`0` disables, `a,b` overrides), BENCH_FM=0 to skip the FM axis,
+YTK_HIGGS_DIR, YTK_CHIP (v5e|v5p|v4|v6e — peak table for utilization),
+plus the engine's YTK_PARTITION / YTK_LADDER / YTK_FUSED /
+YTK_FUSED_MAX_ROWS and the YTK_GOSS_* / YTK_EFB* sampling knobs.
 """
 
 from __future__ import annotations
@@ -81,6 +83,16 @@ HIGGS_BAND = {"logloss": (0.4821, 0.4831), "auc": (0.8455, 0.8462)}
 # synthetic drift band, pinned from the r4 hardware run at the default
 # config (10.5M rows, 40 trees, wave 64, int8)
 SYNTH_BAND = {"auc": (0.9489, 0.005), "logloss": (0.3118, 0.02)}
+#: GOSS (headline default since r11) reads quality slightly BETTER at
+#: short tree counts — +0.005 AUC measured at a 32k-row scale-down of
+#: the synthetic 40-tree config, shrinking with n (amplified gradients
+#: act like a faster early schedule). Quality REGRESSIONS read the other
+#: way, so both bands keep their original tolerance on the regression
+#: side (low auc / high logloss) and grant one-sided headroom in the
+#: improvement direction — same one-sided discipline as the
+#: scripts/ablate_engine.py GOSS quality assertion.
+SYNTH_AUC_HEADROOM = 0.005
+GOSS_IMPROVE_HEADROOM = {"auc": 0.005, "logloss": 0.01}
 
 
 def higgs_dir() -> str:
@@ -170,11 +182,17 @@ def quality_band(source: str, auc: float, logloss: float, knobs_set: bool):
         ll_lo, ll_hi = HIGGS_BAND["logloss"]
         auc_lo, auc_hi = HIGGS_BAND["auc"]
         # the published 3-run spread is tight; allow one band-width of
-        # slack on each side for run-to-run noise on different hardware
+        # slack on each side for run-to-run noise on different hardware,
+        # plus the one-sided GOSS improvement headroom (the band was
+        # pinned unsampled; with GOSS the headline default, metrics may
+        # read HIGH-auc/LOW-logloss by more than the slack — regressions
+        # read the other way, where the original slack still applies)
         ll_w, auc_w = ll_hi - ll_lo, auc_hi - auc_lo
-        if (ll_lo - ll_w) <= logloss <= (ll_hi + ll_w) and (
-            auc_lo - auc_w
-        ) <= auc <= (auc_hi + auc_w):
+        if (ll_lo - GOSS_IMPROVE_HEADROOM["logloss"]) <= logloss <= (
+            ll_hi + ll_w
+        ) and (auc_lo - auc_w) <= auc <= (
+            auc_hi + GOSS_IMPROVE_HEADROOM["auc"]
+        ):
             return "ok"
         return (
             f"logloss {logloss:.4f} / auc {auc:.4f} outside reference band "
@@ -182,10 +200,15 @@ def quality_band(source: str, auc: float, logloss: float, knobs_set: bool):
         )
     auc_c, auc_tol = SYNTH_BAND["auc"]
     ll_c, ll_tol = SYNTH_BAND["logloss"]
-    if abs(auc - auc_c) > auc_tol or abs(logloss - ll_c) > ll_tol:
+    if (
+        (auc_c - auc) > auc_tol
+        or (auc - auc_c) > auc_tol + SYNTH_AUC_HEADROOM
+        or abs(logloss - ll_c) > ll_tol
+    ):
         return (
             f"auc {auc:.4f} / logloss {logloss:.4f} outside "
-            f"band {auc_c}±{auc_tol} / {ll_c}±{ll_tol}"
+            f"band {auc_c}±{auc_tol}(+{SYNTH_AUC_HEADROOM} GOSS headroom)"
+            f" / {ll_c}±{ll_tol}"
         )
     return "ok"
 
@@ -227,6 +250,10 @@ def roofline_fields(stats: dict, n_trees: int) -> dict:
         "fused": "on" if ts.get("fused") else "off",
         "chip": chip,
     }
+    if ts.get("goss"):
+        out["goss_rows_per_tree"] = round(ts.get("goss_rows_per_tree", 0.0))
+    if ts.get("efb_cols_saved"):
+        out["efb_cols_saved"] = round(ts["efb_cols_saved"])
     train_s = ts.get("train", 0.0)
     if not train_s or "hist_macs" not in ts:
         return out
@@ -247,6 +274,31 @@ def roofline_fields(stats: dict, n_trees: int) -> dict:
     return out
 
 
+#: GOSS defaults for the headline run (LightGBM's published top_rate 0.2 /
+#: other_rate 0.1, expressed as our within-remainder rate 0.1/0.8): every
+#: histogram pass runs on ~30% of the rows, quality asserted by the same
+#: band as the unsampled config. BENCH_GOSS=0|off disables; BENCH_GOSS=a,b
+#: overrides; with BENCH_GOSS unset, an explicitly-set YTK_GOSS_A env var
+#: wins over the default (bench passes an explicit goss= pair to the
+#: trainer, which would otherwise shadow the engine knobs the module
+#: docstring advertises). Any explicit setting of either also disables
+#: the quality band, like the other BENCH_* knobs.
+BENCH_GOSS_DEFAULT = (0.2, 0.125)
+
+
+def resolve_goss():
+    raw = os.environ.get("BENCH_GOSS")
+    if raw is None:
+        if knobs.get_raw("YTK_GOSS_A") is not None:
+            return (knobs.get_float("YTK_GOSS_A"), knobs.get_float("YTK_GOSS_B"))
+        return BENCH_GOSS_DEFAULT
+    raw = raw.strip().lower()
+    if raw in ("0", "off", "false", "no"):
+        return (1.0, 0.0)
+    a, _, b = raw.partition(",")
+    return (float(a), float(b) if b else 0.0)
+
+
 def bench_gbdt() -> dict:
     from ytklearn_tpu.config.params import ApproximateSpec, GBDTParams, ModelParams
     from ytklearn_tpu.gbdt.trainer import GBDTTrainer
@@ -256,6 +308,7 @@ def bench_gbdt() -> dict:
     wave_env = os.environ.get("BENCH_WAVE")
     wave = int(wave_env) if wave_env else None  # None = trainer default (64)
     hist = os.environ.get("BENCH_HIST", "int8")
+    goss = resolve_goss()
 
     t0 = time.time()
     train, test, source = resolve_gbdt_data(n, n_test)
@@ -278,8 +331,12 @@ def bench_gbdt() -> dict:
     )
     # int8 histogram quantization (2x MXU rate): measured at this config vs
     # bf16 — test-AUC delta 0.0002 at 60 trees, ~1.2x throughput. Wave
-    # width defaults to the trainer's 64 (r5: 1.218 vs 1.160 trees/s at 32)
-    trainer = GBDTTrainer(params, engine="device", hist_precision=hist, wave=wave)
+    # width defaults to the trainer's 64 (r5: 1.218 vs 1.160 trees/s at 32).
+    # GOSS on by default since r11 (BENCH_GOSS_DEFAULT) — every histogram
+    # pass runs on the sampled ~30% of rows, quality asserted by the band.
+    trainer = GBDTTrainer(
+        params, engine="device", hist_precision=hist, wave=wave, goss=goss
+    )
     res = trainer.train(train=train, test=test)
     assert np.isfinite(res.train_loss) and res.train_loss < 0.65
     assert len(res.model.trees) == n_trees
@@ -300,6 +357,9 @@ def bench_gbdt() -> dict:
         "logloss": float(res.test_loss) if res.test_loss is not None else float("nan"),
         "trees": n_trees,
         "source": source,
+        "goss": (
+            f"a={goss[0]:g},b={goss[1]:g}" if goss[0] < 1.0 else "off"
+        ),
         "roofline": roofline_fields(gbdt_stats_from_obs(trainer), n_trees),
     }
 
@@ -396,13 +456,17 @@ def main() -> None:
         "logloss": round(g["logloss"], 4),
         "trees": g["trees"],
         "data_source": g["source"],
+        "goss": g["goss"],
     }
     out.update(g["roofline"])
     # quality band: reference band on real Higgs, pinned drift band on the
     # default synthetic config. A band failure exits non-zero only AFTER
     # the JSON line is printed, so a quality regression never destroys the
     # throughput artifact.
-    quality_knobs = ("BENCH_ROWS", "BENCH_TEST_ROWS", "BENCH_TREES", "BENCH_WAVE", "BENCH_HIST")
+    quality_knobs = (
+        "BENCH_ROWS", "BENCH_TEST_ROWS", "BENCH_TREES", "BENCH_WAVE",
+        "BENCH_HIST", "BENCH_GOSS", "YTK_GOSS_A", "YTK_GOSS_B",
+    )
     knobs_set = any(os.environ.get(k) is not None for k in quality_knobs)
     band_fail = None
     verdict = quality_band(g["source"], g["auc"], g["logloss"], knobs_set)

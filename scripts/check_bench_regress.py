@@ -20,10 +20,21 @@ but on the latency axes that matter for serving:
   p99 latency           new <= old * (1 + tol)   (the latency band)
   retraces_after_warmup must stay 0
 
+GOSS gate: the newest ABLATION_r*.json holding both a `goss` arm and a
+both-off baseline arm (`part`, else `b256`/`nopart`) is checked WITHIN
+the artifact — the headline ships with GOSS on, so a previous-BENCH
+comparison alone can't see a change that silently degrades the sampling
+win or its quality:
+
+  goss win-rate    goss trees/s >= baseline trees/s * GOSS_MIN_SPEEDUP
+                   (default 1.0 — sampling must never LOSE throughput)
+  goss quality     auc(goss) >= auc(baseline) - GOSS_AUC_TOL (0.005;
+                   one-sided — only a quality loss trips)
+
 Exit 0 with a skip message when fewer than two comparable artifacts exist
 (fresh clones pass — and so do clones that have only training BENCH
-artifacts and no serve ones), exit 1 with the offending axis on
-regression.
+artifacts and no serve ones, or no ablation artifact with goss arms),
+exit 1 with the offending axis on regression.
 
 Usage: scripts/check_bench_regress.py [--dir REPO] [--tol 0.15]
 Wired into the verify recipe next to check_no_print.sh /
@@ -197,6 +208,85 @@ def check_serve(old, new, tol: float) -> List[str]:
     return fails
 
 
+# ---------------------------------------------------------------------------
+# GOSS ablation gate (within-artifact arm comparison)
+# ---------------------------------------------------------------------------
+
+GOSS_BASE_ARMS = ("part", "b256", "nopart")
+
+
+def find_ablation_artifacts(repo: str) -> List[Tuple[int, str]]:
+    """[(round, path)] sorted by round number (ABLATION_r<NN>.json)."""
+    out = []
+    for path in glob.glob(os.path.join(repo, "ABLATION_*.json")):
+        m = re.search(r"ABLATION_r?(\d+)\.json$", os.path.basename(path))
+        if m:
+            out.append((int(m.group(1)), path))
+    return sorted(out)
+
+
+def read_goss_arms(path: str):
+    """(goss arms dict, baseline arm name, configs) from an ablation
+    artifact, or None when the artifact has no goss arm + baseline pair
+    (pre-r11 artifacts skip cleanly)."""
+    import json
+
+    with open(path) as f:
+        rec = json.load(f)
+    configs = rec.get("configs") or {}
+    goss_arms = {
+        name: cfg for name, cfg in configs.items()
+        if name.startswith("goss") and cfg.get("steady_trees_per_sec")
+    }
+    base = next(
+        (a for a in GOSS_BASE_ARMS
+         if configs.get(a, {}).get("steady_trees_per_sec")),
+        None,
+    )
+    if not goss_arms or base is None:
+        return None
+    return goss_arms, base, configs
+
+
+def check_goss(rnd: int, path: str, arms, tol_auc: float, min_speedup: float):
+    """-> failure messages for the within-artifact GOSS arm comparison."""
+    goss_arms, base, configs = arms
+    fails = []
+    b = configs[base]
+    b_tps = float(b["steady_trees_per_sec"])
+    b_auc = b.get("auc")
+    for name, cfg in sorted(goss_arms.items()):
+        tps = float(cfg["steady_trees_per_sec"])
+        ratio = tps / max(b_tps, 1e-12)
+        print(
+            f"  goss win-rate (r{rnd}): {name} {tps:.3f} vs {base} "
+            f"{b_tps:.3f} trees/s = {ratio:.2f}x (floor {min_speedup:.2f}x)"
+        )
+        if ratio < min_speedup:
+            fails.append(
+                f"GOSS arm {name!r} lost its speedup: {ratio:.2f}x vs "
+                f"{base!r} in {os.path.basename(path)} "
+                f"(floor {min_speedup:.2f}x, env GOSS_MIN_SPEEDUP)"
+            )
+        auc = cfg.get("auc")
+        if auc is not None and b_auc is not None:
+            drop = float(b_auc) - float(auc)
+            print(
+                f"  goss quality (r{rnd}): {name} auc {float(auc):.4f} vs "
+                f"{base} {float(b_auc):.4f} (drop {drop:.4f}, "
+                f"tol {tol_auc})"
+            )
+            # one-sided: only a quality LOSS trips the gate (short-run
+            # amplification reading high is not a failure); NaN fails
+            if not (drop <= tol_auc):
+                fails.append(
+                    f"GOSS arm {name!r} lost {drop:.4f} AUC vs "
+                    f"{base!r} in {os.path.basename(path)} (tol {tol_auc}, "
+                    "env GOSS_AUC_TOL)"
+                )
+    return fails
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument(
@@ -231,6 +321,27 @@ def main(argv=None) -> int:
               "comparable artifacts)")
     else:
         fails += check_serve(*serve_pair, tol=args.tol)
+
+    # GOSS gate: newest ablation artifact with goss + baseline arms
+    ablations = find_ablation_artifacts(args.dir)
+    print(f"check_bench_regress: {len(ablations)} ABLATION artifact(s)")
+    goss_arms = None
+    for rnd, path in reversed(ablations):
+        try:
+            goss_arms = read_goss_arms(path)
+        except Exception as e:  # noqa: BLE001 — a rotten artifact is a skip
+            print(f"  [skip] {os.path.basename(path)}: unreadable ({e})")
+            continue
+        if goss_arms is not None:
+            fails += check_goss(
+                rnd, path, goss_arms,
+                tol_auc=float(os.environ.get("GOSS_AUC_TOL", "0.005")),
+                min_speedup=float(os.environ.get("GOSS_MIN_SPEEDUP", "1.0")),
+            )
+            break
+    if goss_arms is None:
+        print("check_bench_regress: SKIP goss gate (no ablation artifact "
+              "with goss + baseline arms)")
 
     if fails:
         for f in fails:

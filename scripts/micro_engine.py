@@ -69,12 +69,15 @@ def main():
     sel_nid = jnp.arange(NW, dtype=jnp.int32)
     sel_feat = jnp.asarray(rng.randint(0, F, NW).astype(np.int32))
     sel_slot = jnp.full((NW,), 128, jnp.int32)
+    sel_lo = jnp.zeros((NW,), jnp.int32)
+    sel_hi = jnp.full((NW,), B - 1, jnp.int32)
     sel_l = jnp.arange(16, 16 + NW, dtype=jnp.int32)
     sel_r = sel_l + 1
 
     route = jax.jit(
         lambda bt, p_: _route_wave(
-            bt, p_, sel_valid, sel_nid, sel_feat, sel_slot, sel_l, sel_r, NW
+            bt, p_, sel_valid, sel_nid, sel_feat, sel_slot, sel_lo, sel_hi,
+            sel_l, sel_r, NW
         )
     )
     timeit("route wave of 16", lambda: route(bins_t, pos))

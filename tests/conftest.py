@@ -52,6 +52,12 @@ def pytest_configure(config):
         "and jax_debug_nans on — the runtime pin of the ytklint "
         "host-sync-in-jit rule",
     )
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 `-m 'not slow'` run (870s wall "
+        "guard); still covered by the full suite under "
+        "scripts/check_suite_time.sh's 40-minute budget",
+    )
 
 
 @pytest.fixture(autouse=True)

@@ -91,9 +91,25 @@ def test_bench_band_selection():
     assert "outside reference band" in bench.quality_band(
         "higgs", 0.80, 0.55, False
     )
-    # synthetic band (r4-pinned)
+    # r11 one-sided GOSS improvement headroom: high auc / low logloss get
+    # extra room, the regression side keeps the original slack
+    assert bench.quality_band("higgs", 0.8500, 0.4770, False) == "ok"
+    assert "outside" in bench.quality_band("higgs", 0.8520, 0.4826, False)
+    assert "outside" in bench.quality_band("higgs", 0.8458, 0.4700, False)
+    assert "outside" in bench.quality_band("higgs", 0.8440, 0.4826, False)
+    assert "outside" in bench.quality_band("higgs", 0.8458, 0.4850, False)
+    # synthetic band (r4-pinned center; r11 one-sided GOSS headroom:
+    # sampling reads AUC high, regressions read low)
     assert bench.quality_band("synthetic", 0.9489, 0.3118, False) == "ok"
     assert "outside" in bench.quality_band("synthetic", 0.93, 0.3118, False)
+    high_ok = bench.SYNTH_BAND["auc"][0] + 0.008  # within tol+headroom
+    assert bench.quality_band("synthetic", high_ok, 0.3118, False) == "ok"
+    assert "outside" in bench.quality_band(
+        "synthetic", bench.SYNTH_BAND["auc"][0] + 0.012, 0.3118, False
+    )
+    assert "outside" in bench.quality_band(  # low side keeps base tol
+        "synthetic", bench.SYNTH_BAND["auc"][0] - 0.006, 0.3118, False
+    )
     # knob set -> no band applies
     assert bench.quality_band("higgs", 0.5, 0.9, True) is None
 

@@ -92,6 +92,62 @@ def test_gbdt_wave_hotpath_is_transfer_clean(gbdt_wave):
 
 
 # ---------------------------------------------------------------------------
+# gbdt: GOSS + EFB growth program (r11 sampling/bundling hot path)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def goss_efb_grow():
+    """A warmed whole-tree growth program with GOSS sampling on and EFB
+    range tables active — the r11 hot path: top_k selection, remainder
+    draw, row compaction, range-corrected split enumeration, range-aware
+    routing, aux-routed full matrix."""
+    from ytklearn_tpu.gbdt.engine import GrowSpec, make_grow_tree
+
+    rng = np.random.RandomState(9)
+    n, F, B = 512, 4, 16
+    bins_np = rng.randint(0, B, size=(F, n)).astype(np.int32)
+    # column 3 plays a two-member bundle: slots [1,7] and [8,15]
+    rlo = np.zeros((F, B), np.int32)
+    rhi = np.full((F, B), B - 1, np.int32)
+    rlo[3, 1:8], rhi[3, 1:8] = 1, 7
+    rlo[3, 8:], rhi[3, 8:] = 8, B - 1
+    g_np = rng.randn(n).astype(np.float32)
+    h_np = np.abs(rng.randn(n)).astype(np.float32) + 0.1
+    spec = GrowSpec(
+        F=F, B=B, max_nodes=15, wave=2, policy="loss", max_depth=8,
+        max_leaves=8, lr=0.3, l1=0.0, l2=1.0, min_h=1e-3, max_abs=0.0,
+        min_split_loss=0.0, min_split_samples=0.0, force_dense=True,
+        goss_a=0.5, goss_b=0.25,
+    )
+    grow = jax.jit(make_grow_tree(spec, ranges=(rlo, rhi)))
+    args = (
+        jnp.asarray(bins_np), jnp.asarray(np.ones(n, bool)),
+        jnp.asarray(g_np), jnp.asarray(h_np),
+        jnp.asarray(np.ones(F, bool)),
+    )
+    key = jax.random.PRNGKey(5)
+    tr, _pos, aux_pos, wlog = grow(*args, key=key)  # warm at exact avals
+    want = {
+        "leaf": jax.device_get(tr.leaf),
+        "pos_train": jax.device_get(aux_pos[0]),
+        "sampled": float(jax.device_get(wlog)[0, 4]),
+    }
+    return grow, args, key, want
+
+
+@pytest.mark.hotpath("gbdt")
+def test_goss_efb_grow_hotpath_is_transfer_clean(goss_efb_grow):
+    grow, args, key, want = goss_efb_grow
+    tr, _pos, aux_pos, wlog = grow(*args, key=key)
+    leaf, pos_train, wlog_np = jax.device_get((tr.leaf, aux_pos[0], wlog))
+    np.testing.assert_array_equal(leaf, want["leaf"])
+    np.testing.assert_array_equal(pos_train, want["pos_train"])
+    # the sampled-row count is the GOSS contract: top half + 1/4 remainder
+    assert wlog_np[0, 4] == want["sampled"] == 256 + 64
+
+
+# ---------------------------------------------------------------------------
 # convex train: the jitted L-BFGS first_eval/iteration programs
 # ---------------------------------------------------------------------------
 

@@ -109,6 +109,20 @@ _knob("YTK_FUSED_MAX_ROWS", "int", 1 << 18,
       "max gathered rows per fused-kernel call (VMEM sizing)")
 _knob("YTK_PROFILE_DIR", "str", None,
       "write a jax.profiler trace of the training loop for xprof")
+_knob("YTK_GOSS_A", "float", 1.0,
+      "GOSS top-gradient-magnitude keep fraction per tree in the device "
+      "GBDT engine; a value < 1 enables gradient-based one-side sampling")
+_knob("YTK_GOSS_B", "float", 0.1,
+      "GOSS sample rate on the non-top remainder (sampled rows carry the "
+      "1/b gradient-amplification correction); active only when "
+      "`YTK_GOSS_A` < 1")
+_knob("YTK_EFB", "bool", True,
+      "exclusive feature bundling at GBDT binning time: merge mutually-"
+      "exclusive sparse columns into offset-binned bundles (no-op when "
+      "no such columns exist)")
+_knob("YTK_EFB_CONFLICT", "int", 0,
+      "max conflicting rows tolerated per EFB bundle (0 = strictly "
+      "exclusive, lossless; >0 trades exactness for wider bundles)")
 
 # -- observability ----------------------------------------------------------
 _knob("YTK_OBS", "str", None,
@@ -135,8 +149,9 @@ _knob("YTK_FLIGHT", "bool", True,
       "flight-recorder auto-install in trainers; `0` opts out")
 _knob("YTK_FLIGHT_N", "int", 4096,
       "flight-recorder event-ring capacity")
-_knob("YTK_FLIGHT_DIR", "str", None,
-      "flight-dump directory (default: current directory)")
+_knob("YTK_FLIGHT_DIR", "str", "flight_dumps",
+      "flight-dump directory (default: `flight_dumps/`, which is "
+      "gitignored — a crash dump must never end up committed)")
 
 # -- serving ----------------------------------------------------------------
 _knob("YTK_SERVE_LADDER", "str", None,

@@ -354,6 +354,280 @@ def build_bins_global(
     )
 
 
+# ---------------------------------------------------------------------------
+# Exclusive feature bundling (EFB, LightGBM §5): merge mutually-exclusive
+# sparse columns into one offset-binned column at binning time, shrinking
+# the bin matrix's feature axis before it ever reaches HBM.
+# ---------------------------------------------------------------------------
+#
+# Bundle-column bin layout: bin 0 is the shared DEFAULT (every member at
+# its zero value); member j's NONZERO bins 1..B_j-1 land at
+# [lo_j, lo_j + B_j - 2] with lo offsets accumulating member widths.
+# Candidates are restricted to columns with min >= 0 whose lowest
+# representative is exactly 0, so "original bin 0" == "value 0" and the
+# encoding is invertible. Conflict rows (two members nonzero) keep the
+# higher-offset member's value — deterministic, and identical for train
+# and test transforms. With conflict budget 0 the transform is lossless:
+# the engine's range-corrected split enumeration (engine.split_kernel
+# `ranges`) recovers exactly the per-original-feature splits, and
+# `unbundle_split` maps a chosen (bundle, slot) back to the original
+# feature id + bin interval, so dumped models and serving are unchanged.
+
+#: candidate pre-filter: a column this dense can never bundle usefully
+#: (and keeps the pairwise conflict matmul off dense features entirely)
+EFB_MAX_DENSITY = 0.5
+#: skip EFB planning past this many candidate columns (the conflict
+#: matrix is O(C^2) memory)
+EFB_MAX_CANDIDATES = 4096
+
+
+@dataclass
+class BundlePlan:
+    """Column plan for an EFB-bundled bin matrix.
+
+    Column layout: the unbundled original features first (in original
+    order, `col_fid[c]` = original fid), then one column per bundle.
+    `member_lo[b][k]`/`member_hi[b][k]` give member k's nonzero slot
+    range inside bundle b's column."""
+
+    n_features: int  # original F
+    col_fid: np.ndarray  # (U,) i32: unbundled column -> original fid
+    bundles: List[List[int]]  # each: >= 2 original fids, offset order
+    member_lo: List[List[int]]
+    member_hi: List[List[int]]
+
+    @property
+    def n_cols(self) -> int:
+        return len(self.col_fid) + len(self.bundles)
+
+    @property
+    def n_bundled_features(self) -> int:
+        return sum(len(m) for m in self.bundles)
+
+    def bundle_width(self, b: int) -> int:
+        return self.member_hi[b][-1] + 1
+
+    def range_tables(self, B: int, F_pad: Optional[int] = None):
+        """(range_lo, range_hi) (F_pad, B) int32 for engine.split_kernel:
+        plain columns (and padding) get [0, B-1]; a bundle column's slot s
+        gets the member range containing s. Slots outside any member
+        range (bin 0, tail padding) keep [0, B-1] — they are never valid
+        split boundaries (bin 0 has no predecessor; tail slots are
+        empty), so the value only has to be harmless."""
+        F_pad = F_pad or self.n_cols
+        rlo = np.zeros((F_pad, B), np.int32)
+        rhi = np.full((F_pad, B), B - 1, np.int32)
+        U = len(self.col_fid)
+        for b in range(len(self.bundles)):
+            for lo, hi in zip(self.member_lo[b], self.member_hi[b]):
+                rlo[U + b, lo : hi + 1] = lo
+                rhi[U + b, lo : hi + 1] = hi
+        return rlo, rhi
+
+    def member_of_slot(self, col: int, slot: int):
+        """(original fid, member lo) of the member whose nonzero range
+        contains `slot` in bundle column `col`."""
+        b = col - len(self.col_fid)
+        for fid, lo, hi in zip(
+            self.bundles[b], self.member_lo[b], self.member_hi[b]
+        ):
+            if lo <= slot <= hi:
+                return fid, lo
+        raise ValueError(
+            f"slot {slot} of bundle column {col} is in no member range"
+        )
+
+    def unbundle_split(self, col: int, slot_l: int, slot_r: int):
+        """Map a chosen split (column, boundary interval [slot_l, slot_r])
+        back to (original fid, original slot_l, original slot_r).
+
+        Plain columns pass through. For a bundle, the boundary slot_r
+        identifies the member; bundle slot s maps to original bin
+        s - lo + 1 (member nonzero bins start at original bin 1), and a
+        slot_l below the member's range (the lo-1 default encoding from
+        split_kernel, or bin 0) maps to the original zero bin 0."""
+        U = len(self.col_fid)
+        if col < U:
+            return int(self.col_fid[col]), slot_l, slot_r
+        fid, lo = self.member_of_slot(col, slot_r)
+        orig_r = slot_r - lo + 1
+        orig_l = 0 if slot_l < lo else slot_l - lo + 1
+        return fid, orig_l, orig_r
+
+    def summary(self) -> str:
+        sizes = ",".join(str(len(m)) for m in self.bundles)
+        return (
+            f"{self.n_bundled_features} of {self.n_features} features in "
+            f"{len(self.bundles)} bundle(s) [{sizes}]: "
+            f"{self.n_features} -> {self.n_cols} columns"
+        )
+
+
+def efb_candidates(
+    nnz: np.ndarray,
+    mins: np.ndarray,
+    bins: FeatureBins,
+    n_rows: int,
+    max_density: float = EFB_MAX_DENSITY,
+) -> np.ndarray:
+    """Original fids eligible for bundling: sparse (nnz fraction under the
+    density cap), non-negative, at least one nonzero bin, and binned so
+    that value 0 IS bin 0 (lowest representative exactly 0 — the offset
+    encoding's invertibility condition)."""
+    F = len(nnz)
+    out = []
+    for f in range(F):
+        cnt = int(bins.counts[f])
+        if (
+            cnt >= 2
+            and nnz[f] > 0
+            and nnz[f] <= max_density * n_rows
+            and mins[f] >= 0
+            and float(bins.values[f, 0]) == 0.0
+        ):
+            out.append(f)
+    return np.asarray(out, np.int64)
+
+
+def plan_bundles(
+    cand: np.ndarray,
+    conflicts: np.ndarray,
+    bin_counts: np.ndarray,
+    F: int,
+    max_conflict: int,
+    max_width: int,
+) -> Optional[BundlePlan]:
+    """Greedy graph-coloring over the candidate conflict counts
+    (LightGBM Alg. 3): visit candidates by nonzero count (conflict-matrix
+    diagonal) descending, place each into the first bundle whose total
+    conflict stays within `max_conflict` and whose width (1 shared
+    default bin + each member's nonzero bins) fits `max_width`. Bundles
+    that end up with one member stay unbundled. Returns None when nothing
+    bundles (the caller's no-op path)."""
+    if len(cand) < 2:
+        return None
+    nnz = np.diag(conflicts)
+    order = np.argsort(-nnz, kind="stable")  # dense-first, fid tie-break
+    groups: List[List[int]] = []  # candidate-local indices
+    g_conf: List[int] = []
+    g_width: List[int] = []
+    for ci in order:
+        w = int(bin_counts[cand[ci]]) - 1  # nonzero bins
+        placed = False
+        for gi, members in enumerate(groups):
+            add = int(sum(conflicts[ci, m] for m in members))
+            if g_conf[gi] + add <= max_conflict and g_width[gi] + w <= max_width:
+                members.append(int(ci))
+                g_conf[gi] += add
+                g_width[gi] += w
+                placed = True
+                break
+        if not placed:
+            groups.append([int(ci)])
+            g_conf.append(0)
+            g_width.append(1 + w)
+    bundles = [
+        sorted(int(cand[m]) for m in members)
+        for members in groups
+        if len(members) >= 2
+    ]
+    if not bundles:
+        return None
+    bundles.sort()  # deterministic column order by smallest member fid
+    bundled = set()
+    for members in bundles:
+        bundled.update(members)
+    col_fid = np.asarray(
+        [f for f in range(F) if f not in bundled], np.int32
+    )
+    member_lo: List[List[int]] = []
+    member_hi: List[List[int]] = []
+    for members in bundles:
+        lo_list, hi_list = [], []
+        off = 1  # bin 0 = shared default
+        for fid in members:
+            w = int(bin_counts[fid]) - 1
+            lo_list.append(off)
+            hi_list.append(off + w - 1)
+            off += w
+        member_lo.append(lo_list)
+        member_hi.append(hi_list)
+    return BundlePlan(
+        n_features=F,
+        col_fid=col_fid,
+        bundles=bundles,
+        member_lo=member_lo,
+        member_hi=member_hi,
+    )
+
+
+def build_bundle_plan(
+    X_t,
+    bins: FeatureBins,
+    max_conflict: int,
+    max_width: int,
+    nnz: Optional[np.ndarray] = None,
+    mins: Optional[np.ndarray] = None,
+) -> Optional[BundlePlan]:
+    """Plan EFB bundles from a transposed (F, n) matrix (device jnp array
+    or host numpy — the nonzero-pattern reductions and the candidate
+    conflict matmul run wherever the matrix lives). Host callers can pass
+    precomputed (nnz, mins) from gbdt.data.column_stats to keep the
+    full-matrix boolean pattern from materializing. Returns None when
+    nothing bundles."""
+    import jax.numpy as jnp
+
+    is_dev = not isinstance(X_t, np.ndarray)
+    xp = jnp if is_dev else np
+    F, n = X_t.shape
+    if nnz is None:
+        nnz = np.asarray(xp.sum(X_t != 0, axis=1)).astype(np.int64)
+    if mins is None:
+        mins = np.asarray(xp.min(X_t, axis=1))
+    cand = efb_candidates(nnz, mins, bins, n)
+    if len(cand) < 2:
+        return None
+    C = len(cand)
+    if C > EFB_MAX_CANDIDATES:
+        return None  # O(C^2) conflict matrix would blow memory; skip
+    # exact pairwise co-nonzero counts, chunked over rows so the (C, chunk)
+    # f32 nonzero pattern stays within a fixed memory budget on either
+    # backend (budget 0 MUST see every conflict — a sampled estimate could
+    # silently bundle conflicting features)
+    Xc = X_t[xp.asarray(cand)] if is_dev else X_t[np.asarray(cand)]
+    # chunk cap 2^22 keeps per-chunk counts exactly representable in f32
+    chunk = min(1 << 22, max(8192, (1 << 26) // max(C, 1)))
+    conflicts = np.zeros((C, C), np.float64)
+    for i in range(0, n, chunk):
+        Zc = (Xc[:, i : i + chunk] != 0).astype(xp.float32)
+        conflicts += np.asarray(Zc @ Zc.T, np.float64)
+    conflicts = np.rint(conflicts).astype(np.int64)  # [i,j] = co-nonzero rows
+    return plan_bundles(
+        cand, conflicts, bins.counts, F, max_conflict, max_width
+    )
+
+
+def bundle_bin_matrix_t(bins_t, plan: BundlePlan):
+    """Apply a BundlePlan to a transposed (F, n) BIN matrix -> (n_cols, n).
+
+    Works on device (jnp) and host (np) arrays alike. Bundle encoding per
+    row: member j nonzero (orig bin > 0) -> lo_j + bin_j - 1, all-default
+    -> 0; the elementwise max picks the highest-offset member on conflict
+    rows (the budgeted-conflict winner rule)."""
+    import jax.numpy as jnp
+
+    xp = np if isinstance(bins_t, np.ndarray) else jnp
+    parts = [bins_t[np.asarray(plan.col_fid)]] if len(plan.col_fid) else []
+    for b, members in enumerate(plan.bundles):
+        acc = None
+        for fid, lo in zip(members, plan.member_lo[b]):
+            bf = bins_t[fid].astype(xp.int32)
+            enc = xp.where(bf > 0, lo + bf - 1, 0)
+            acc = enc if acc is None else xp.maximum(acc, enc)
+        parts.append(acc[None].astype(bins_t.dtype))
+    return xp.concatenate(parts, axis=0)
+
+
 def quantile_bins_device(
     X_t,
     weight: Optional[np.ndarray],

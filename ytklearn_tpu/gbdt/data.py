@@ -434,6 +434,23 @@ class GBDTIngest:
         return train, test
 
 
+def column_stats(
+    X: np.ndarray, chunk: int = 1 << 20
+) -> Tuple[np.ndarray, np.ndarray]:
+    """(nonzero counts, mins) per column of a dense (n, F) matrix, chunked
+    over rows so the boolean nonzero pattern never materializes whole —
+    the host-side feed for EFB candidate selection (gbdt.binning
+    .efb_candidates; the device path reduces on the accelerator instead)."""
+    n, F = X.shape
+    nnz = np.zeros((F,), np.int64)
+    mins = np.full((F,), np.inf, X.dtype)
+    for i in range(0, n, chunk):
+        blk = X[i : i + chunk]
+        nnz += np.count_nonzero(blk, axis=0)
+        np.minimum(mins, blk.min(axis=0), out=mins)
+    return nnz, mins
+
+
 def _apply_fill(X: np.ndarray, fill: np.ndarray) -> None:
     """In-place NaN -> per-feature fill (reference: FillMissingValue.java:49)."""
     nan_rows, nan_cols = np.where(np.isnan(X))

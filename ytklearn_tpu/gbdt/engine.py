@@ -41,7 +41,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .hist import BMG_DEFAULT, hist_wave, hist_wave_gather, hist_wave_q
+from .hist import (
+    BMG_DEFAULT,
+    compact_indices,
+    hist_wave,
+    hist_wave_gather,
+    hist_wave_q,
+)
 from .route import route_wave
 
 BIG32 = np.int32(2**31 - 1)
@@ -84,30 +90,71 @@ def make_gain_fns(l1: float, l2: float, min_h: float, max_abs: float):
 
 
 @partial(jax.jit, static_argnames=("cfg",))
-def split_kernel(hist, feat_mask, cfg):
+def split_kernel(hist, feat_mask, cfg, ranges=None):
     """Best split per node from (N, F, B, 3) histograms.
 
     Returns per-node: (loss_chg, flat_idx, slot_left, GL, HL, CL, GR, HR, CR)
     (reference: DataParallelTreeMaker.enumerateSplit:598-637 — empty slots
     skipped, split interval [last nonempty, current], child-hessian guards,
     gain vs root; first-max argmax reproduces SplitInfo.needReplace:99's
-    lower-slot tie-break)."""
+    lower-slot tie-break).
+
+    ranges: optional (range_lo, range_hi) (F, B) int32 tables for EFB
+    bundle columns — range_lo[f, s]/range_hi[f, s] bound the member
+    feature's slot range containing s (lo=0/hi=B-1 for plain columns).
+    A bundled column concatenates its members' nonzero bins after a
+    shared default bin 0, so a boundary s inside member j must count the
+    member's DEFAULT rows (node total minus j's nonzero-range sum) on the
+    left — LightGBM's per-feature sub-histogram enumeration as a closed
+    form over the bundle cumsum: left_j(s) = C(s) + (total - C(hi_j+1)).
+    With hi = B-1 the correction is identically zero, so plain columns
+    keep the original math bit-for-bit."""
     l1, l2, min_h, max_abs = cfg
     N, F, B, _ = hist.shape
     G, H, C = hist[..., 0], hist[..., 1], hist[..., 2]
     gain, _ = make_gain_fns(l1, l2, min_h, max_abs)
 
     # exclusive cumsums: stats strictly left of boundary slot j
-    GL = jnp.cumsum(G, axis=-1) - G
-    HL = jnp.cumsum(H, axis=-1) - H
-    CL = jnp.cumsum(C, axis=-1) - C
+    CGi = jnp.cumsum(G, axis=-1)  # inclusive
+    CHi = jnp.cumsum(H, axis=-1)
+    CCi = jnp.cumsum(C, axis=-1)
+    GL = CGi - G
+    HL = CHi - H
+    CL = CCi - C
     Gt = jnp.sum(G, axis=-1, keepdims=True)
     Ht = jnp.sum(H, axis=-1, keepdims=True)
     Ct = jnp.sum(C, axis=-1, keepdims=True)
-    GR, HR, CR = Gt - GL, Ht - HL, Ct - CL
 
     nonempty = C > 0
-    has_prev = (jnp.cumsum(nonempty.astype(jnp.int32), axis=-1) - nonempty) > 0
+    ne_incl = jnp.cumsum(nonempty.astype(jnp.int32), axis=-1)
+    # ytklint: allow(host-sync-in-jit) reason=`ranges is None` is static pytree dispatch (None vs arrays picks the compiled variant), not a traced comparison
+    if ranges is None:
+        has_prev = (ne_incl - nonempty) > 0
+    else:
+        rlo, rhi = ranges  # (F, B) i32, broadcast over nodes
+
+        def at_hi(A):  # inclusive cumsum at the member range's end == C(hi+1)
+            return jnp.take_along_axis(
+                A, jnp.broadcast_to(rhi[None], A.shape), axis=-1
+            )
+
+        def at_lo_excl(A_incl, A):  # exclusive cumsum at lo == C(lo)
+            ex = A_incl - A
+            return jnp.take_along_axis(
+                ex, jnp.broadcast_to(rlo[None], ex.shape), axis=-1
+            )
+
+        # member-default stats fold into the left side: total - C(hi+1)
+        GL = GL + (Gt - at_hi(CGi))
+        HL = HL + (Ht - at_hi(CHi))
+        CL = CL + (Ct - at_hi(CCi))
+        # per-member has_prev: a nonempty slot in [lo, s), or a nonempty
+        # member default bin (rows of this member's zero value + every
+        # other member's rows)
+        ne_in_range = (ne_incl - nonempty) - at_lo_excl(ne_incl, nonempty) > 0
+        dflt_cnt = Ct - (at_hi(CCi) - at_lo_excl(CCi, C))
+        has_prev = ne_in_range | (dflt_cnt > 0)
+    GR, HR, CR = Gt - GL, Ht - HL, Ct - CL
     valid = nonempty & has_prev & (HL >= min_h) & (HR >= min_h)
     valid = valid & feat_mask[None, :, None]
 
@@ -127,7 +174,13 @@ def split_kernel(hist, feat_mask, cfg):
     lastne_incl = jax.lax.cummax(idxs, axis=2)
     lastne = jnp.concatenate(
         [jnp.full((N, F, 1), -1, lastne_incl.dtype), lastne_incl[:, :, :-1]], axis=2
-    ).reshape(N, F * B)
+    )
+    # ytklint: allow(host-sync-in-jit) reason=`ranges is not None` is static pytree dispatch, not a traced comparison
+    if ranges is not None:
+        # clamp to the member range: lo-1 encodes "the member default bin"
+        # (unbundles to the original feature's zero bin)
+        lastne = jnp.maximum(lastne, (rlo - 1)[None])
+    lastne = lastne.reshape(N, F * B)
     slot_left = jnp.take_along_axis(lastne, best[:, None], axis=-1)[:, 0]
 
     def pick(A):
@@ -197,6 +250,24 @@ class GrowSpec:
     fused_max_rows: int = 1 << 18
     fused_interpret: bool = False
     bm_g: int = BMG_DEFAULT
+    # GOSS (gradient-based one-side sampling, LightGBM §4): per tree,
+    # keep the top goss_a fraction of rows by |g| (jax.lax.top_k), sample
+    # the remainder at rate goss_b with a deterministic counter-based
+    # draw (threefry fold_in on the round/group key — no host RNG), and
+    # amplify the sampled rows' g/h by 1/goss_b. The kept set is
+    # compacted into a static (a + b(1-a))-sized fit matrix that the
+    # whole growth program runs on, so every histogram pass — full-scan
+    # phases included — costs O(sampled rows); the full matrix rides
+    # along as an aux set purely for final leaf assignment. goss_a >= 1
+    # disables (the bit-identical unsampled path). goss_scale is the
+    # caller's real-row fraction of the padded sample axis (top_k needs a
+    # STATIC k, so the fractions apply to scale*n instead of the padded
+    # n — without it a heavily-padded shard would "sample" every real
+    # row); the include re-mask guarantees padding is never selected
+    # either way.
+    goss_a: float = 1.0
+    goss_b: float = 0.0
+    goss_scale: float = 1.0
 
     @property
     def depth_cap(self) -> int:
@@ -238,15 +309,25 @@ class _Frontier(NamedTuple):
     active: jnp.ndarray  # (M,) bool
 
 
-def _route_wave(bins_t, pos, sel_valid, sel_nid, sel_feat, sel_slot, sel_l, sel_r, NW):
+def _route_wave(
+    bins_t, pos, sel_valid, sel_nid, sel_feat, sel_slot, sel_lo, sel_hi,
+    sel_l, sel_r, NW,
+):
     """Move samples of each wave node to its children: one bins_t row
-    dynamic-slice + compare per wave slot (masked no-op when invalid)."""
+    dynamic-slice + compare per wave slot (masked no-op when invalid).
+
+    sel_lo/sel_hi bound the split's EFB member range: a row goes right
+    only when its bin is inside [lo, hi] AND above the slot — bins
+    outside the range are other bundle members (the split feature's
+    default/zero value, which sits left). Plain columns pass lo=0,
+    hi=B-1, reducing to the original `bin > slot` compare."""
     n = pos.shape[0]
 
     def body(i, pos):
         f = jnp.maximum(sel_feat[i], 0)
         row = jax.lax.dynamic_slice(bins_t, (f, jnp.zeros((), f.dtype)), (1, n))[0]
-        go_right = row > sel_slot[i]
+        row = row.astype(jnp.int32)
+        go_right = (row > sel_slot[i]) & (row >= sel_lo[i]) & (row <= sel_hi[i])
         child = jnp.where(go_right, sel_r[i], sel_l[i])
         upd = jnp.where(pos == sel_nid[i], child, pos)
         return jnp.where(sel_valid[i], upd, pos)
@@ -254,19 +335,32 @@ def _route_wave(bins_t, pos, sel_valid, sel_nid, sel_feat, sel_slot, sel_l, sel_
     return jax.lax.fori_loop(0, NW, body, pos)
 
 
-def make_grow_tree(spec: GrowSpec, mesh=None, axis: str = "data"):
-    """Build the jittable grow(bins_t, include, g, h, feat_mask[, aux]) fn.
+def make_grow_tree(spec: GrowSpec, mesh=None, axis: str = "data", ranges=None):
+    """Build the jittable grow(bins_t, include, g, h, feat_mask[, aux, key]) fn.
 
     aux: optional (bins_t_extra, ...) tuple of extra transposed bin
     matrices (e.g. the test set) whose row positions are routed through
     the same splits; their final leaf assignment comes back alongside.
+    key: PRNG key for the GOSS remainder draw (required semantics only
+    when spec.goss_a < 1 and goss_b > 0; defaults to PRNGKey(0)). Under a
+    mesh each shard folds in its axis index, so per-shard draws are
+    independent and deterministic.
+    ranges: optional (range_lo, range_hi) GLOBAL (F, B) int32 EFB member-
+    range tables (see split_kernel); sliced per shard for enumeration,
+    used whole for routing.
+
+    With spec.goss_a < 1 the returned pos is the leaf assignment of the
+    COMPACTED fit rows; the full training matrix is routed as the first
+    aux entry, so callers read the train positions from aux_pos[0] and
+    their own aux sets from aux_pos[1:].
 
     Returns (TreeArrays, pos_final, aux_pos_final, wave_log) where
-    wave_log (max_nodes+8, 4) f32 records per histogram pass
-    [rows_scanned, rows_needed, splits, hist_width] — the roofline and
-    O(wave rows) ablation record (row 0 = root pass; rows with
-    hist_width 0 are unused slots; row counts are per-shard under a
-    mesh, exact on one device).
+    wave_log (max_nodes+8, 5) f32 records per histogram pass
+    [rows_scanned, rows_needed, splits, hist_width, rows_sampled] — the
+    roofline and O(wave rows) ablation record (row 0 = root pass; rows
+    with hist_width 0 are unused slots; rows_sampled is the GOSS-kept
+    row count, == the included-row count when GOSS is off; row counts
+    are per-shard under a mesh, exact on one device).
 
     With a mesh of >1 devices the SAME growth program runs under
     `shard_map` over row shards — each device feeds its local rows to the
@@ -283,7 +377,7 @@ def make_grow_tree(spec: GrowSpec, mesh=None, axis: str = "data"):
     spec.bm) on TPU.
     """
     n_shards = 1 if mesh is None else int(mesh.devices.size)
-    grow = _build_grow(spec, n_shards, axis)
+    grow = _build_grow(spec, n_shards, axis, ranges)
     if n_shards == 1:
         return grow
 
@@ -291,26 +385,30 @@ def make_grow_tree(spec: GrowSpec, mesh=None, axis: str = "data"):
 
     from ..parallel.mesh import shard_map_compat
 
-    def grow_sharded(bins_t, include, g, h, feat_mask, aux=()):
-        def f(bins_t, include, g, h, feat_mask, aux):
-            return grow(bins_t, include, g, h, feat_mask, aux=aux)
+    def grow_sharded(bins_t, include, g, h, feat_mask, aux=(), key=None):
+        if key is None:
+            key = jax.random.PRNGKey(0)
+
+        def f(bins_t, include, g, h, feat_mask, aux, key):
+            return grow(bins_t, include, g, h, feat_mask, aux=aux, key=key)
 
         return shard_map_compat(
             f,
             mesh=mesh,
             in_specs=(
-                P(None, axis), P(axis), P(axis), P(axis), P(axis), P(None, axis),
+                P(None, axis), P(axis), P(axis), P(axis), P(axis),
+                P(None, axis), P(),
             ),
             # wave_log is replicated: rows/splits/width are static or come
             # from the globally-merged frontier stats
             out_specs=(P(), P(axis), P(axis), P()),
             check_vma=False,
-        )(bins_t, include, g, h, feat_mask, tuple(aux))
+        )(bins_t, include, g, h, feat_mask, tuple(aux), key)
 
     return grow_sharded
 
 
-def _build_grow(spec: GrowSpec, n_shards: int = 1, axis: str = "data"):
+def _build_grow(spec: GrowSpec, n_shards: int = 1, axis: str = "data", ranges=None):
     """The growth program body; n_shards>1 = running inside shard_map."""
     M, NW, F, B = spec.max_nodes, spec.wave, spec.F, spec.B
     F_loc = F // max(n_shards, 1)
@@ -318,6 +416,12 @@ def _build_grow(spec: GrowSpec, n_shards: int = 1, axis: str = "data"):
     cfg = (spec.l1, spec.l2, spec.min_h, spec.max_abs)
     _, node_value = make_gain_fns(*cfg)
     iota_m = jnp.arange(M, dtype=jnp.int32)
+    if ranges is not None:
+        rlo_g = jnp.asarray(ranges[0], jnp.int32)  # (F, B) global tables
+        rhi_g = jnp.asarray(ranges[1], jnp.int32)
+        assert rlo_g.shape == (F, B), (rlo_g.shape, F, B)
+    else:
+        rlo_g = rhi_g = None
 
     if n_shards > 1:
         from ..parallel.collectives import pargmax_tuple, psum_scatter
@@ -326,13 +430,26 @@ def _build_grow(spec: GrowSpec, n_shards: int = 1, axis: str = "data"):
             """Partial (N, F, B, 3|i32) -> globally-summed owned F-slice."""
             return psum_scatter(local, axis, tiled=True, scatter_dimension=1)
 
-        def best_splits(hists, fmask_loc):
+        def local_ranges():
+            """This shard's contiguous F-slice of the EFB range tables
+            (hi/lo values are slot indices WITHIN a column's own bin
+            axis, so slicing along F needs no re-offsetting)."""
+            if rlo_g is None:
+                return None
+            dev = jax.lax.axis_index(axis)
+            start = (dev * F_loc, jnp.zeros((), jnp.int32))
+            return (
+                jax.lax.dynamic_slice(rlo_g, start, (F_loc, B)),
+                jax.lax.dynamic_slice(rhi_g, start, (F_loc, B)),
+            )
+
+        def best_splits(hists, fmask_loc, ranges_loc=None):
             """split_kernel on the owned slice + global pargmax merge.
 
             Local flat indices are offset into global (f, slot) coords;
             pargmax's lower-rank tie-break equals the single-device
             first-max tie-break because feature slices are contiguous."""
-            out = split_kernel(hists, fmask_loc, cfg)
+            out = split_kernel(hists, fmask_loc, cfg, ranges_loc)
             dev = jax.lax.axis_index(axis)
             gflat = out[1] + dev * (F_loc * B)
             chg, payload = pargmax_tuple(out[0], (gflat,) + out[2:], axis)
@@ -342,8 +459,11 @@ def _build_grow(spec: GrowSpec, n_shards: int = 1, axis: str = "data"):
         def combine_hist(local):
             return local
 
-        def best_splits(hists, fmask_loc):
-            return split_kernel(hists, fmask_loc, cfg)
+        def local_ranges():
+            return None if rlo_g is None else (rlo_g, rhi_g)
+
+        def best_splits(hists, fmask_loc, ranges_loc=None):
+            return split_kernel(hists, fmask_loc, cfg, ranges_loc)
 
     def can_split(fr: _Frontier, tr: TreeArrays, leaves):
         ok = fr.active & jnp.isfinite(fr.chg) & (fr.chg > spec.min_split_loss)
@@ -363,11 +483,63 @@ def _build_grow(spec: GrowSpec, n_shards: int = 1, axis: str = "data"):
         sel = sel[:nw]
         return sel, ok[sel]
 
-    def grow(bins_t, include, g, h, feat_mask, aux=()):
+    def grow(bins_t, include, g, h, feat_mask, aux=(), key=None):
+        ranges_loc = local_ranges()
+        goss_on = 0.0 < spec.goss_a < 1.0
+        goss_rows = None  # per-shard GOSS-kept row count (wave-log col 4)
+        if goss_on:
+            n_full = bins_t.shape[1]
+            gunit = 128 if spec.force_dense else spec.bm
+            # static top/remainder counts over the REAL rows (goss_scale
+            # discounts padding; re-masked below so padding never leaks)
+            n_eff = max(1, min(n_full, int(np.ceil(spec.goss_scale * n_full))))
+            k_a = max(1, min(n_eff, int(np.ceil(spec.goss_a * n_eff))))
+            k_b = 0
+            if spec.goss_b > 0.0:
+                k_b = min(
+                    n_eff - k_a, int(np.ceil(spec.goss_b * (n_eff - k_a)))
+                )
+            if key is None:
+                key = jax.random.PRNGKey(0)
+            if n_shards > 1:
+                # independent, deterministic per-shard draws
+                key = jax.random.fold_in(key, jax.lax.axis_index(axis))
+            # top-a by |g|: exact-k via index scatter (top_k's lowest-index
+            # tie-break keeps this deterministic); padding/excluded rows
+            # carry -1 and sort last, the & include re-mask drops any that
+            # leaked in when a*n_pad exceeds the real row count
+            absg = jnp.where(include, jnp.abs(g), -1.0)
+            _, idx_top = jax.lax.top_k(absg, k_a)
+            keep = (
+                jnp.zeros((n_full,), bool).at[idx_top].set(True) & include
+            )
+            if k_b > 0:
+                u = jax.random.uniform(key, (n_full,))
+                rest = include & ~keep
+                _, idx_r = jax.lax.top_k(jnp.where(rest, u, -1.0), k_b)
+                rmask = jnp.zeros((n_full,), bool).at[idx_r].set(True) & rest
+                amp = jnp.float32(1.0 / spec.goss_b)
+                g = jnp.where(rmask, g * amp, g)
+                h = jnp.where(rmask, h * amp, h)
+                keep = keep | rmask
+            # compact the kept rows into the static fit matrix (order-
+            # preserving, so int8 histogram sums stay bit-stable); the
+            # full matrix becomes aux[0] purely for final leaf assignment
+            R_fit = max(gunit, -(-(k_a + k_b) // gunit) * gunit)
+            R_fit = min(R_fit, n_full)
+            idx_fit, goss_rows = compact_indices(keep, R_fit)
+            valid_fit = jnp.arange(R_fit, dtype=jnp.int32) < goss_rows
+            aux = (bins_t,) + tuple(aux)
+            bins_t = jnp.take(bins_t, idx_fit, axis=1)
+            g = jnp.where(valid_fit, jnp.take(g, idx_fit), 0.0)
+            h = jnp.where(valid_fit, jnp.take(h, idx_fit), 0.0)
+            include = valid_fit
+
         n = bins_t.shape[1]
         pos = jnp.zeros((n,), jnp.int32)
         aux_pos = tuple(jnp.zeros((bt.shape[1],), jnp.int32) for bt in aux)
-        iota_n = jnp.arange(n, dtype=jnp.int32)
+        if goss_rows is None:
+            goss_rows = jnp.sum(include, dtype=jnp.float32)
 
         # leaf-partition budget ladder (static shapes, ascending): a wave
         # hists only smaller children, so ceil(n/2) always fits budget 0.
@@ -476,10 +648,7 @@ def _build_grow(spec: GrowSpec, n_shards: int = 1, axis: str = "data"):
                 mask = jnp.zeros(pos_fit.shape, bool)
                 for k in range(int(ids.shape[0])):  # static width unroll
                     mask = mask | (pos_fit == ids[k])
-                csum = jnp.cumsum(mask.astype(jnp.int32))
-                cnt = csum[-1]
-                dest = jnp.where(mask, csum - 1, R)
-                idx = jnp.zeros((R,), jnp.int32).at[dest].set(iota_n, mode="drop")
+                idx, cnt = compact_indices(mask, R)
                 valid = jnp.arange(R, dtype=jnp.int32) < cnt
                 pg = jnp.where(valid, jnp.take(pos_fit, idx), -1)
                 gg = jnp.take(G_, idx)
@@ -542,7 +711,7 @@ def _build_grow(spec: GrowSpec, n_shards: int = 1, axis: str = "data"):
         pool = jnp.zeros((M, F_loc, B, 3), jnp.float32)
         pool = pool.at[0].set(hist0[0])
 
-        out0 = best_splits(hist0[:1], feat_mask)
+        out0 = best_splits(hist0[:1], feat_mask, ranges_loc)
         f32 = jnp.float32
         fr = _Frontier(
             chg=jnp.full((M,), -jnp.inf, f32).at[0].set(out0[0][0]),
@@ -559,7 +728,8 @@ def _build_grow(spec: GrowSpec, n_shards: int = 1, axis: str = "data"):
         leaves0 = jnp.asarray(1, jnp.int32)
 
         # wave log: [rows_scanned (static hist cost), rows_needed (exact
-        # smaller-child sum), splits made, hist width N] per wave — the
+        # smaller-child sum), splits made, hist width N, rows_sampled
+        # (GOSS-kept rows; included rows when GOSS is off)] per wave — the
         # roofline/ablation record (fetched once per tree, a few KB).
         # Row 0 is the root histogram pass. ALL row counts are PER-SHARD
         # (rows_scanned is the local n / local budget R already; the need
@@ -568,11 +738,19 @@ def _build_grow(spec: GrowSpec, n_shards: int = 1, axis: str = "data"):
         # stay unit-consistent on a mesh. Exact on one device.
         MW = wave_log_rows(M)  # waves <= splits + slow-start ramp + root
         inv_shards = 1.0 / float(max(n_shards, 1))
-        wlog0 = jnp.zeros((MW, 4), jnp.float32)
+        goss_rows_f = goss_rows.astype(jnp.float32)
+        if n_shards > 1:
+            # the wave log ships replicated (out_specs P()): per-shard kept
+            # counts can differ, so col 4 carries the cross-shard MEAN —
+            # the same per-shard units as the other row columns
+            from ..parallel.collectives import psum as _psum
+
+            goss_rows_f = _psum(goss_rows_f, axis) * inv_shards
+        wlog0 = jnp.zeros((MW, 5), jnp.float32)
         wlog0 = wlog0.at[0].set(
             jnp.stack([
                 jnp.float32(n), root_ghc[2] * inv_shards,
-                jnp.float32(0.0), jnp.float32(1.0),
+                jnp.float32(0.0), jnp.float32(1.0), goss_rows_f,
             ])
         )
         wcnt0 = jnp.asarray(1, jnp.int32)
@@ -622,6 +800,15 @@ def _build_grow(spec: GrowSpec, n_shards: int = 1, axis: str = "data"):
             f_best = fr.flat[nid] // B
             slot_r = fr.flat[nid] % B
             slot_l = fr.slotl[nid]
+            if rlo_g is not None:
+                # EFB member range of the chosen boundary slot (global
+                # tables: f_best is a global column id) — bounds routing
+                # so other bundle members' rows stay on the default side
+                sel_lo = rlo_g[f_best, slot_r]
+                sel_hi = rhi_g[f_best, slot_r]
+            else:
+                sel_lo = jnp.zeros_like(f_best)
+                sel_hi = jnp.full_like(f_best, B - 1)
             GLs, HLs, CLs = fr.GL[nid], fr.HL[nid], fr.CL[nid]
             GRs, HRs, CRs = fr.GR[nid], fr.HR[nid], fr.CR[nid]
             child_depth = tr.depth[nid] + 1
@@ -650,18 +837,26 @@ def _build_grow(spec: GrowSpec, n_shards: int = 1, axis: str = "data"):
             # routing (train + any aux sets)
             if spec.force_dense:
                 pos = _route_wave(
-                    bins_t, pos, sel_ok, nid, f_best, slot_l, lch, rch, nw
+                    bins_t, pos, sel_ok, nid, f_best, slot_l, sel_lo, sel_hi,
+                    lch, rch, nw,
                 )
                 aux_pos = tuple(
-                    _route_wave(bt, ap, sel_ok, nid, f_best, slot_l, lch, rch, nw)
+                    _route_wave(
+                        bt, ap, sel_ok, nid, f_best, slot_l, sel_lo, sel_hi,
+                        lch, rch, nw,
+                    )
                     for bt, ap in zip(aux, aux_pos)
                 )
             else:
                 pos = route_wave(
-                    bins_k, pos, sel_ok, nid, f_best, slot_l, lch, rch, bm=spec.bm
+                    bins_k, pos, sel_ok, nid, f_best, slot_l, lch, rch,
+                    bm=spec.bm, lo=sel_lo, hi=sel_hi,
                 )
                 aux_pos = tuple(
-                    route_wave(bt, ap, sel_ok, nid, f_best, slot_l, lch, rch, bm=spec.bm)
+                    route_wave(
+                        bt, ap, sel_ok, nid, f_best, slot_l, lch, rch,
+                        bm=spec.bm, lo=sel_lo, hi=sel_hi,
+                    )
                     for bt, ap in zip(aux_k, aux_pos)
                 )
 
@@ -680,7 +875,7 @@ def _build_grow(spec: GrowSpec, n_shards: int = 1, axis: str = "data"):
             child_ids = jnp.concatenate([small, big])
             child_ok = jnp.concatenate([sel_ok, sel_ok])
             hists = jnp.concatenate([h_small, h_big], axis=0)
-            out = best_splits(hists, feat_mask)
+            out = best_splits(hists, feat_mask, ranges_loc)
             cids = jnp.where(child_ok, child_ids, M)
             fr = _Frontier(
                 chg=fr.chg.at[scatter_id].set(-jnp.inf, **drop).at[cids].set(out[0], **drop),
@@ -703,7 +898,8 @@ def _build_grow(spec: GrowSpec, n_shards: int = 1, axis: str = "data"):
             rows_f = jnp.float32(n if hist_rows is None else hist_rows)
             wlog = wlog.at[wcnt].set(
                 jnp.stack([
-                    rows_f, need, k_cnt.astype(jnp.float32), jnp.float32(nw)
+                    rows_f, need, k_cnt.astype(jnp.float32), jnp.float32(nw),
+                    goss_rows_f,
                 ]),
                 mode="drop",
             )

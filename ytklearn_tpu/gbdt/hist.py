@@ -528,6 +528,28 @@ def hist_wave_gather(
     return jnp.transpose(out, (2, 0, 3, 1))
 
 
+def compact_indices(mask, R: int):
+    """Order-preserving compaction of a boolean row mask into a static
+    (R,) index buffer: `idx[:cnt]` are the positions of the True entries
+    in ascending order, slots at/past `cnt` point at row 0 (callers mask
+    them out — the fused gather kernel via pos_g = -1, the GOSS fit set
+    via an `arange(R) < cnt` validity mask). Shared by the engine's
+    leaf-partitioned budget gathers and the per-tree GOSS row selection,
+    so both hot paths compact rows with the same scatter idiom.
+
+    Returns (idx (R,) int32, cnt () int32). Requires R >= true-count
+    (overflow entries are dropped by the scatter's drop mode — callers
+    size R from static knowledge)."""
+    n = mask.shape[0]
+    csum = jnp.cumsum(mask.astype(jnp.int32))
+    cnt = csum[-1]
+    dest = jnp.where(mask, csum - 1, R)
+    idx = jnp.zeros((R,), jnp.int32).at[dest].set(
+        jnp.arange(n, dtype=jnp.int32), mode="drop"
+    )
+    return idx, cnt
+
+
 def pad_inputs(
     bins: np.ndarray, bm: int = BM_DEFAULT, n_pad: int = None, F_pad: int = None
 ):

@@ -20,7 +20,7 @@ import jax.numpy as jnp
 
 
 @partial(jax.jit, static_argnames=("bm",))
-def _route_pallas(bins4, pos, valid, nid, feat, slot, lch, rch, bm: int):
+def _route_pallas(bins4, pos, valid, nid, feat, slot, lo, hi, lch, rch, bm: int):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -28,7 +28,10 @@ def _route_pallas(bins4, pos, valid, nid, feat, slot, lch, rch, bm: int):
     n = nblk * bm
     NW = nid.shape[0]
     pos3 = pos.reshape(nblk, 1, bm)
-    # pack the per-slot scalars into one (8, NW) i32 table (SMEM-resident)
+    # pack the per-slot scalars into one (8, NW) i32 table (SMEM-resident);
+    # rows 6/7 carry the split's EFB member range [lo, hi] — a row goes
+    # right only when its bin is inside the range AND above the slot
+    # (plain columns pass lo=0/hi=B-1, reducing to the bin > slot compare)
     tab = jnp.stack(
         [
             valid.astype(jnp.int32),
@@ -37,8 +40,8 @@ def _route_pallas(bins4, pos, valid, nid, feat, slot, lch, rch, bm: int):
             slot,
             lch,
             rch,
-            jnp.zeros((NW,), jnp.int32),
-            jnp.zeros((NW,), jnp.int32),
+            lo,
+            hi,
         ]
     )
 
@@ -48,10 +51,12 @@ def _route_pallas(bins4, pos, valid, nid, feat, slot, lch, rch, bm: int):
         for i in range(NW):
             f = tab_ref[2, i]
             row = bins_ref[pl.ds(f, 1), 0, 0, :]  # (1, bm), dynamic sublane
+            ri = row.astype(jnp.int32)
             m = (p == tab_ref[1, i]) & (tab_ref[0, i] != 0)
-            child = jnp.where(
-                row.astype(jnp.int32) > tab_ref[3, i], tab_ref[5, i], tab_ref[4, i]
+            go_right = (
+                (ri > tab_ref[3, i]) & (ri >= tab_ref[6, i]) & (ri <= tab_ref[7, i])
             )
+            child = jnp.where(go_right, tab_ref[5, i], tab_ref[4, i])
             newp = jnp.where(m, child, newp)
         out_ref[0, 0, :] = newp[0]
 
@@ -71,11 +76,21 @@ def _route_pallas(bins4, pos, valid, nid, feat, slot, lch, rch, bm: int):
     )(tab, bins4, pos3).reshape(n)
 
 
-def route_wave(bins_t, pos, valid, nid, feat, slot, lch, rch, bm: int = 8192):
+def route_wave(
+    bins_t, pos, valid, nid, feat, slot, lch, rch, bm: int = 8192,
+    lo=None, hi=None,
+):
     """One-pass wave routing; XLA fallback off-TPU (see engine._route_wave).
 
-    bins_t: (F, n) or pre-tiled (F, nblk, 1, bm)."""
+    bins_t: (F, n) or pre-tiled (F, nblk, 1, bm). lo/hi: optional per-slot
+    EFB member-range bounds (default: unbounded, the plain bin > slot
+    compare)."""
     F = bins_t.shape[0]
+    NW = nid.shape[0]
+    if lo is None:
+        lo = jnp.zeros((NW,), jnp.int32)
+    if hi is None:
+        hi = jnp.full((NW,), 2**30, jnp.int32)
     if jax.default_backend() == "tpu":
         bins4 = (
             bins_t
@@ -84,12 +99,12 @@ def route_wave(bins_t, pos, valid, nid, feat, slot, lch, rch, bm: int = 8192):
         )
         return _route_pallas(
             bins4, pos, valid, nid,
-            jnp.maximum(feat, 0), slot, lch, rch, bm,
+            jnp.maximum(feat, 0), slot, lo, hi, lch, rch, bm,
         )
     from .engine import _route_wave
 
     bins2 = bins_t if bins_t.ndim == 2 else bins_t.reshape(F, -1)
     return _route_wave(
-        bins2, pos, valid, nid, jnp.maximum(feat, 0), slot, lch, rch,
-        nid.shape[0],
+        bins2, pos, valid, nid, jnp.maximum(feat, 0), slot, lo, hi, lch, rch,
+        NW,
     )

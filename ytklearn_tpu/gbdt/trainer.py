@@ -63,8 +63,10 @@ from .binning import (
     bin_matrix_device,
     build_bins_global,
     build_bins_maybe_device,
+    build_bundle_plan,
+    bundle_bin_matrix_t,
 )
-from .data import GBDTData, GBDTIngest
+from .data import GBDTData, GBDTIngest, column_stats
 from .engine import (
     GrowSpec,
     make_gain_fns,
@@ -73,7 +75,7 @@ from .engine import (
     wave_log_rows,
 )
 from .hist import BM_DEFAULT, pad_inputs
-from .tree import GBDTModel, Tree
+from .tree import GBDTModel, Tree, unbundle_tree
 
 log = logging.getLogger("ytklearn_tpu.gbdt")
 
@@ -149,7 +151,7 @@ class _DevInputs:
     weight: jnp.ndarray
     real_mask: jnp.ndarray
     n_score: int  # global (cross-process) padded row count
-    F: int  # real feature count
+    F: int  # engine-visible real column count (EFB-bundled when active)
     F_prog: int  # feature axis padded to the mesh device count
     B: int  # bin axis padded to a power of two
     D: int  # mesh device count
@@ -179,6 +181,8 @@ class GBDTTrainer:
         wave: Optional[int] = None,
         use_bf16_hist: bool = True,
         hist_precision: Optional[str] = None,  # bf16 | f32 | int8
+        goss: Optional[Tuple[float, float]] = None,  # (a, b); a >= 1 = off
+        efb: Optional[bool] = None,  # None = YTK_EFB knob
     ):
         self.params = params
         self.mesh = mesh
@@ -210,7 +214,34 @@ class GBDTTrainer:
                 f"hist_precision must be bf16|f32|int8, got {hist_precision!r}"
             )
         self.hist_precision = hist_precision
-        self.use_bf16_hist = hist_precision != "f32" 
+        self.use_bf16_hist = hist_precision != "f32"
+        # GOSS (device engine): explicit ctor pair wins, else the knobs.
+        # a >= 1 disables — the engine then takes the bit-identical
+        # unsampled path.
+        if goss is None:
+            goss = (
+                knobs.get_float("YTK_GOSS_A"),
+                knobs.get_float("YTK_GOSS_B"),
+            )
+        a, b = float(goss[0]), float(goss[1])
+        if not (0.0 < a <= 1.0) or not (0.0 <= b <= 1.0):
+            raise ValueError(
+                f"goss=(a, b) needs 0 < a <= 1 and 0 <= b <= 1, got {goss!r}"
+            )
+        self.goss = (a, b)
+        if a < 1.0 and self.engine == "host":
+            log.warning(
+                "GOSS (goss_a=%.3f) is a device-engine feature; the host "
+                "engine trains unsampled", a,
+            )
+        self.efb = knobs.get_bool("YTK_EFB") if efb is None else bool(efb)
+        if self.efb and self.engine == "host":
+            # warn only on an explicit request — the knob defaults to on,
+            # so every host-engine run would otherwise nag
+            (log.warning if efb else log.info)(
+                "EFB is a device-engine feature; the host engine trains "
+                "on the unbundled bin matrix"
+            )
 
     def _put(self, arr):
         """Row-shard dim 0. Multi-process: `arr` is this process's shard."""
@@ -292,7 +323,7 @@ class GBDTTrainer:
     # DEVICE ENGINE
     # ======================================================================
 
-    def _grow_spec(self, F: int, B: int) -> GrowSpec:
+    def _grow_spec(self, F: int, B: int, goss_scale: float = 1.0) -> GrowSpec:
         p = self.params
         caps = []
         if p.max_leaf_cnt > 0:
@@ -362,6 +393,9 @@ class GBDTTrainer:
             ladder=ladder,
             fused=fused,
             fused_max_rows=fused_max_rows,
+            goss_a=self.goss[0],
+            goss_b=self.goss[1],
+            goss_scale=goss_scale,
         )
 
     def _prep_device_inputs(self, train: GBDTData, test: Optional[GBDTData]):
@@ -391,22 +425,64 @@ class GBDTTrainer:
             bins = build_bins_global(train.X, train.weight, p, train.feature_names)
         B_real = bins.max_bins
         B = max(8, 1 << (B_real - 1).bit_length())  # pad to pow2 for tiling
+        # EFB: merge mutually-exclusive sparse columns into offset-binned
+        # bundles BEFORE the matrix reaches HBM. Bundles are capped at the
+        # padded bin width B, so the histogram shape never grows; the
+        # engine's range tables + tree unbundling keep splits (and every
+        # dumped model) in original feature space. Gated off multi-process
+        # (the plan would need a cross-process conflict merge) and under
+        # continue_train (score replay re-derives slots from original
+        # feature values).
+        plan = None
+        if self.efb and p.model.continue_train:
+            log.info(
+                "EFB disabled: continue_train score replay needs the "
+                "unbundled bin matrix"
+            )
+        elif self.efb and jax.process_count() > 1:
+            log.info(
+                "EFB disabled: multi-process runs would need a cross-"
+                "process conflict merge; training unbundled"
+            )
+        elif self.efb:
+            budget = knobs.get_int("YTK_EFB_CONFLICT")
+            with obs_span("gbdt.efb.plan", F=F):
+                if use_dev_bin:
+                    plan = build_bundle_plan(X_t_dev, bins, budget, B)
+                else:
+                    nnz, mins = column_stats(train.X)
+                    plan = build_bundle_plan(
+                        train.X.T, bins, budget, B, nnz=nnz, mins=mins
+                    )
+            if plan is not None:
+                log.info("EFB: %s (conflict budget %d)", plan.summary(), budget)
+                obs_inc("gbdt.efb.bundles", len(plan.bundles))
+                obs_inc("gbdt.efb.features_bundled", plan.n_bundled_features)
+                obs_gauge("gbdt.stat.efb_cols_saved", float(F - plan.n_cols))
+        self._efb_plan = plan
+        F_cols = plan.n_cols if plan is not None else F
         # mesh>1: the growth program runs under shard_map with each device
         # owning a contiguous feature slice of the histograms — pad the
         # feature axis so it divides evenly (padded features: all rows in
         # bin 0 + masked off, so they can never split)
         D = 1 if self.mesh is None else int(self.mesh.devices.size)
-        F_prog = -(-F // D) * D
+        F_prog = -(-F_cols // D) * D
         if use_dev_bin:
             n_rows = train.X.shape[0]
             n_pad = -(-n_rows // BM_DEFAULT) * BM_DEFAULT
             Xp = jnp.pad(X_t_dev, ((0, 0), (0, n_pad - n_rows)))
             bins_t = bin_matrix_device(Xp, bins)
+            if plan is not None:
+                bins_t = bundle_bin_matrix_t(bins_t, plan)
             if B <= 256:
                 bins_t = bins_t.astype(jnp.uint8)  # quarter the routing/DMA
             del X_t_dev, Xp
         else:
             bins_np = bin_matrix(train.X, bins)
+            if plan is not None:
+                bins_np = np.asarray(
+                    bundle_bin_matrix_t(bins_np.T, plan)
+                ).T
             bins_t_np, n_pad = pad_inputs(
                 bins_np, n_pad=self._shard_target(bins_np), F_pad=F_prog
             )
@@ -429,12 +505,18 @@ class GBDTTrainer:
                     jnp.transpose(jax.device_put(test.X)), ((0, 0), (0, nt_pad - nt))
                 )
                 bt_dev = bin_matrix_device(Xt_t, bins)
+                if plan is not None:
+                    bt_dev = bundle_bin_matrix_t(bt_dev, plan)
                 if B <= 256:
                     bt_dev = bt_dev.astype(jnp.uint8)
                 aux_bins = (bt_dev,)
                 del Xt_t, bt_dev
             else:
                 bins_test_np = bin_matrix(test.X, bins)
+                if plan is not None:
+                    bins_test_np = np.asarray(
+                        bundle_bin_matrix_t(bins_test_np.T, plan)
+                    ).T
                 bt_np, nt_pad = pad_inputs(
                     bins_test_np, n_pad=self._shard_target(bins_test_np),
                     F_pad=F_prog,
@@ -448,7 +530,7 @@ class GBDTTrainer:
         )
         return _DevInputs(
             bins=bins, bins_t=bins_t, y=y, weight=weight, real_mask=real_mask,
-            n_score=n_score, F=F, F_prog=F_prog, B=B, D=D,
+            n_score=n_score, F=F_cols, F_prog=F_prog, B=B, D=D,
             aux_bins=aux_bins, y_t=y_t, w_t=w_t, nt_score=nt_score,
         )
 
@@ -495,21 +577,27 @@ class GBDTTrainer:
             "cnt": jnp.zeros((T, M), jnp.float32),
             "n_nodes": jnp.zeros((T,), jnp.int32),
             # per-tree wave log from grow(): [rows_scanned, rows_needed,
-            # splits, hist_width] per histogram pass — the roofline /
-            # O(wave rows) ablation record (~8 KB per tree)
-            "wlog": jnp.zeros((T, wave_log_rows(M), 4), jnp.float32),
+            # splits, hist_width, rows_sampled] per histogram pass — the
+            # roofline / O(wave rows) ablation record (~10 KB per tree)
+            "wlog": jnp.zeros((T, wave_log_rows(M), 5), jnp.float32),
         }
         loss_buf = jnp.zeros((p.round_num,), jnp.float32)
         tloss_buf = jnp.zeros((p.round_num,), jnp.float32)
         return bufs, loss_buf, tloss_buf
 
-    def _make_round_step(self, dd: "_DevInputs", grow, has_test: bool):
+    def _make_round_step(
+        self, dd: "_DevInputs", grow, has_test: bool, spec: GrowSpec,
+    ):
         """Build the jitted per-round program: grads -> K tree growths ->
         score/loss updates (reference: GBDTOptimizer.doBoost:482 +
         predictAndCalcLossGrad:513 as ONE device program per round)."""
         p = self.params
         K = self.K
         F, F_prog = dd.F, dd.F_prog
+        # GOSS: grow() fits on the compacted sample and routes the full
+        # train matrix as its first aux set — train leaf assignment comes
+        # back in aux_pos[0], the caller-supplied aux sets shift by one
+        goss_on = 0.0 < spec.goss_a < 1.0
         loss_fn = self.loss
         inst_rate = p.instance_sample_rate
         feat_rate = p.feature_sample_rate
@@ -533,7 +621,7 @@ class GBDTTrainer:
             scores, scores_t, bufs, loss_buf, tloss_buf = carry
             preds = loss_fn.predict(scores)
             gs, hs = loss_fn.grad_hess(preds, y)
-            kf, ki = jax.random.split(key)
+            kf, ki, kg = jax.random.split(key, 3)
             # weight-0 rows still count in the histogram count channel
             # (weight folds into g/h only), matching the host engine and the
             # reference's per-node sample counting
@@ -552,13 +640,19 @@ class GBDTTrainer:
                 g = (gs[:, grp] if K > 1 else gs) * weight
                 h = (hs[:, grp] if K > 1 else hs) * weight
                 tr, pos, aux_pos, wlog = grow(
-                    bins_t, include, g, h, fmask, aux=aux_bins
+                    bins_t, include, g, h, fmask, aux=aux_bins,
+                    key=jax.random.fold_in(kg, grp),
                 )
+                if goss_on:
+                    pos_train, aux_pos = aux_pos[0], aux_pos[1:]
+                else:
+                    pos_train = pos
                 if refine_lad:
                     tr = _lad_refine_device(
-                        tr, pos, y, scores, weight, real_mask, p.learning_rate
+                        tr, pos_train, y, scores, weight, real_mask,
+                        p.learning_rate,
                     )
-                add = tr.leaf[pos]
+                add = tr.leaf[pos_train]
                 if K > 1:
                     scores = scores.at[:, grp].add(add)
                 else:
@@ -595,8 +689,13 @@ class GBDTTrainer:
         return jax.jit(round_step, donate_argnums=(0,))
 
     def _build_round_step(self, dd: "_DevInputs", spec: GrowSpec, has_test: bool):
-        grow = make_grow_tree(spec, mesh=self.mesh if dd.D > 1 else None)
-        return self._make_round_step(dd, grow, has_test)
+        ranges = None
+        if self._efb_plan is not None:
+            ranges = self._efb_plan.range_tables(dd.B, F_pad=dd.F_prog)
+        grow = make_grow_tree(
+            spec, mesh=self.mesh if dd.D > 1 else None, ranges=ranges
+        )
+        return self._make_round_step(dd, grow, has_test, spec)
 
     def _probe_compile(
         self, jit_round, carry, data, dd, has_test: bool, spec: GrowSpec,
@@ -663,12 +762,14 @@ class GBDTTrainer:
         work only (histogram one-hot matmuls + routing traffic); split
         enumeration and score updates are O(nodes) / O(n) per ROUND and
         small beside them."""
-        wl = self.wave_log  # (T, MW, 4)
+        wl = self.wave_log  # (T, MW, 5)
         used = wl[..., 3] > 0
         F, B = dd.F_prog, dd.B
         bins_bytes = 1 if dd.B <= 256 else 4
         rows_scanned = float((wl[..., 0] * used).sum())
-        n_trees = float(used.any(axis=-1).sum())
+        trees_used = used.any(axis=-1)
+        n_trees = float(trees_used.sum())
+        goss_on = 0.0 < spec.goss_a < 1.0
         ts["hist_passes"] = float(used.sum())
         ts["hist_rows_scanned"] = rows_scanned
         ts["hist_rows_needed"] = float((wl[..., 1] * used).sum())
@@ -680,18 +781,36 @@ class GBDTTrainer:
         ts["hist_bytes"] = rows_scanned * (F * bins_bytes + 12)
         # routing: every wave re-reads each row's bins + pos, writes pos
         # (root pass routes nothing). Per-DEVICE rows, matching the wave
-        # log's per-shard units and the single-chip peak comparison.
+        # log's per-shard units and the single-chip peak comparison. The
+        # fit-matrix width comes from each tree's root pass (== n per
+        # shard unsampled, the compacted width under GOSS); with GOSS the
+        # full train matrix ALSO routes every wave as an aux set for the
+        # final leaf assignment.
         rows_per_device = dd.n_score / max(dd.D, 1)
-        route_waves = float(used.sum()) - n_trees
-        ts["route_bytes"] = route_waves * rows_per_device * (F * bins_bytes + 8)
+        fit_rows = wl[:, 0, 0]  # (T,) per-shard fit width per tree
+        route_waves_t = np.maximum(used.sum(axis=-1) - 1, 0)
+        routed_rows = fit_rows + (rows_per_device if goss_on else 0.0)
+        ts["route_bytes"] = float(
+            (route_waves_t * routed_rows * trees_used).sum()
+        ) * (F * bins_bytes + 8)
         ts["partition"] = bool(spec.partition)
         ts["fused"] = bool(
             spec.partition and spec.fused
             and (not spec.force_dense or spec.fused_interpret)
         )
-        self._publish_wave_obs(wl, used)
+        ts["goss"] = goss_on
+        if goss_on:
+            ts["goss_a"] = float(spec.goss_a)
+            ts["goss_b"] = float(spec.goss_b)
+            # per-shard GOSS-kept rows per tree (wave-log col 4, constant
+            # within a tree) — the sampled-rows evidence next to
+            # scanned/needed
+            ts["goss_rows_per_tree"] = float(
+                (wl[:, 0, 4] * trees_used).sum() / max(n_trees, 1.0)
+            )
+        self._publish_wave_obs(wl, used, goss_on)
 
-    def _publish_wave_obs(self, wl, used) -> None:
+    def _publish_wave_obs(self, wl, used, goss_on: bool = False) -> None:
         """Accumulate the wave log into obs counters ONCE PER TREE (the
         registry is the shared source bench and any report reads; the
         per-tree granularity keeps tree-level events available without a
@@ -706,14 +825,18 @@ class GBDTTrainer:
             scanned = float((wl[t, :, 0] * u).sum())
             needed = float((wl[t, :, 1] * u).sum())
             splits = float((wl[t, :, 2] * u).sum())
+            sampled = float(wl[t, 0, 4])
             obs_inc("gbdt.trees")
             obs_inc("gbdt.waves", waves)
             obs_inc("gbdt.hist_rows_scanned", scanned)
             obs_inc("gbdt.hist_rows_needed", needed)
             obs_inc("gbdt.splits", splits)
+            if goss_on:
+                obs_inc("gbdt.goss.trees")
+                obs_inc("gbdt.goss.rows_sampled", sampled)
             obs_event(
                 "gbdt.tree", tree=t, waves=waves, rows_scanned=scanned,
-                rows_needed=needed, splits=splits,
+                rows_needed=needed, splits=splits, rows_sampled=sampled,
             )
 
     def _run_rounds(
@@ -819,7 +942,12 @@ class GBDTTrainer:
         ts["preprocess"] = time.time() - t0 - ts["load"]
         log.info("load+preprocess %.1fs", time.time() - t0)
 
-        spec = self._grow_spec(dd.F_prog, dd.B)
+        # GOSS sizing discounts sample-axis padding (real-row fraction of
+        # the per-process padded shard; top_k needs a static k, so the
+        # engine can't count real rows itself)
+        n_pad_local = dd.n_score // max(jax.process_count(), 1)
+        goss_scale = min(1.0, train.n_real / max(n_pad_local, 1))
+        spec = self._grow_spec(dd.F_prog, dd.B, goss_scale=goss_scale)
 
         base_np = self._base_score(train, K)
         model = GBDTModel(
@@ -1006,10 +1134,6 @@ class GBDTTrainer:
         nn = int(d["n_nodes"])
         t = Tree()
         t.feat = [int(v) for v in d["feat"][:nn]]
-        t.feat_name = [
-            (names[f] if (names and 0 <= f < len(names)) else str(f)) if f >= 0 else ""
-            for f in t.feat
-        ]
         t.slot = [int(v) for v in d["slot"][:nn]]
         t.split = [float(v) for v in d["slot_r"][:nn]]  # slot-space pre-convert
         t.left = [int(v) for v in d["left"][:nn]]
@@ -1019,6 +1143,15 @@ class GBDTTrainer:
         t.gain = [float(v) for v in d["gain"][:nn]]
         t.hess_sum = [float(v) for v in d["hess"][:nn]]
         t.sample_cnt = [int(round(float(v))) for v in d["cnt"][:nn]]
+        if self._efb_plan is not None:
+            # bundle-space (column, slot interval) -> original feature +
+            # bin interval, BEFORE names and value conversion, so the
+            # dumped model is indistinguishable from an unbundled run
+            unbundle_tree(t, self._efb_plan)
+        t.feat_name = [
+            (names[f] if (names and 0 <= f < len(names)) else str(f)) if f >= 0 else ""
+            for f in t.feat
+        ]
         self._convert_tree(t, bins)
         return t
 
@@ -1455,6 +1588,7 @@ class GBDTTrainer:
                 tree.default_left[nid] = bool(fill[fid] <= cond)
 
     _missing_fill: Optional[np.ndarray] = None
+    _efb_plan = None  # BundlePlan when EFB merged columns this run
 
     def _tree_scores_from_raw(self, tree: Tree, bins: FeatureBins, bins_dev):
         """Score a converted (value-space) tree against the bin matrix by

@@ -241,6 +241,25 @@ class Tree:
                 acc[name] = (cnt + 1, gain + float(self.gain[nid]))
 
 
+def unbundle_tree(tree: "Tree", plan) -> None:
+    """Rewrite a tree grown on an EFB-bundled bin matrix back into
+    ORIGINAL feature space, in place: every inner node's column id and
+    slot interval (`feat`, `slot`, `split` — still slot-space, pre value
+    conversion) map through `plan.unbundle_split`, so the downstream
+    split-value conversion, dumps, feature importance, and serving see
+    only real features. `plan` is a gbdt.binning.BundlePlan (duck-typed
+    here to keep tree.py free of a binning import)."""
+    for nid in range(tree.n_nodes()):
+        if tree.is_leaf(nid):
+            continue
+        fid, slot_l, slot_r = plan.unbundle_split(
+            tree.feat[nid], tree.slot[nid], int(tree.split[nid])
+        )
+        tree.feat[nid] = fid
+        tree.slot[nid] = slot_l
+        tree.split[nid] = float(slot_r)
+
+
 def _jfloat(v: float) -> str:
     """Java Float.toString-ish rendering (shortest round-trip of float32)."""
     return repr(float(np.float32(v)))

@@ -13,7 +13,9 @@ abnormal-exit paths:
   atexit           dump only when an abnormal condition was flagged earlier
                    (a clean exit writes nothing)
 
-`dump()` writes `flight_<ts>_<pid>.json` to `YTK_FLIGHT_DIR` (default cwd).
+`dump()` writes `flight_<ts>_<pid>.json` to `YTK_FLIGHT_DIR` (default
+`flight_dumps/`, created on demand — gitignored so a crash dump can
+never end up committed).
 The file is a valid Chrome-trace/Perfetto document — `traceEvents` holds
 the ring as complete "X"/"i" events plus counter samples, so
 https://ui.perfetto.dev opens it directly — with one extra `flight` block
@@ -21,8 +23,8 @@ https://ui.perfetto.dev opens it directly — with one extra `flight` block
 process info) that `scripts/obs_report.py` renders as a run-health report.
 
 Knobs:
-  YTK_FLIGHT_N=4096   ring capacity (events)
-  YTK_FLIGHT_DIR=.    dump directory
+  YTK_FLIGHT_N=4096              ring capacity (events)
+  YTK_FLIGHT_DIR=flight_dumps    dump directory (gitignored default)
   YTK_FLIGHT=0        disable auto_install() (trainers call it; explicit
                       install() still works)
 
@@ -189,7 +191,9 @@ def _dump(reason: str, exc: Optional[BaseException]) -> str:
     _state.dump_seq += 1
     ts = time.strftime("%Y%m%d-%H%M%S")
     name = f"flight_{ts}_{os.getpid()}_{_state.dump_seq}.json"
-    path = os.path.join(_flight_dir(), name)
+    out_dir = _flight_dir()
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(out_dir, name)
     doc = {
         "traceEvents": trace_events,
         "displayTimeUnit": "ms",
