@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# Continuous-training launcher (no reference counterpart — the reference
+# retrained offline and restarted its predictors; docs/continual.md).
+# Warm-start a candidate on new data, gate it against the serving
+# incumbent, and atomically promote on pass; the serving registry's
+# watcher hot-swaps the promoted model under traffic.
+set -euo pipefail
+
+REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+export PYTHONPATH="${REPO_ROOT}${PYTHONPATH:+:${PYTHONPATH}}"
+
+# usage: retrain.sh <model_name> <config_path> [--data new.ytk]
+#        [--mode warm|ftrl] [--extra-rounds N] [--rollback] [extra args...]
+model_name="${1:?usage: retrain.sh <model_name> <config_path> [extra args...]}"
+properties_path="${2:?usage: retrain.sh <model_name> <config_path> [extra args...]}"
+shift 2
+
+exec python -m ytklearn_tpu.cli retrain "${model_name}" "${properties_path}" "$@"

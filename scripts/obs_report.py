@@ -188,11 +188,42 @@ def report(path: str) -> None:
     for e in health_ev[-10:]:
         print(f"  event {e['name']} @ {e.get('ts', 0):.3f}s {e.get('args', {})}")
 
-    downs = _prefixed(counters, "gbdt.downgrade.")
+    downs = {
+        k: v
+        for k, v in counters.items()
+        if k.startswith(("gbdt.downgrade.", "gbdt.efb.downgrade"))
+    }
     if downs:
         _section("downgrades")
         for k, v in sorted(downs.items()):
             print(f"  {k:<40s} {v:g}")
+
+    cont_c = _prefixed(counters, "continual.")
+    cont_ev = [
+        e for e in events
+        if e.get("name") in ("continual.promoted", "continual.rejected",
+                             "continual.rollback")
+    ]
+    if cont_c or cont_ev:
+        _section("continual training (promotions / rejections)")
+        for k in ("continual.retrains", "continual.promoted",
+                  "continual.rejected", "continual.rollbacks"):
+            if k in cont_c:
+                print(f"  {k:<40s} {cont_c[k]:g}")
+        for k, v in sorted(cont_c.items()):
+            if k.startswith("continual.ftrl"):
+                print(f"  {k:<40s} {v:g}")
+        # the promotion/rejection/rollback event trail, newest last: each
+        # names the version, losses, and (for rejects) every failed gate
+        for e in cont_ev[-10:]:
+            args = e.get("args", {})
+            detail = " ".join(
+                f"{k}={args[k]}"
+                for k in ("version", "from_version", "to_version", "model",
+                          "candidate_loss", "incumbent_loss", "reasons")
+                if k in args
+            )
+            print(f"  event {e['name']} @ {e.get('ts', 0):.3f}s {detail}")
 
     mem = _prefixed(gauges, "mem.")
     if mem:

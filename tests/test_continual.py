@@ -1,0 +1,725 @@
+"""Continuous-training subsystem (ytklearn_tpu/continual, docs/continual.md).
+
+Covers the whole train->serve freshness loop on synthetic data (no
+/root/reference needed, tier-1): FTRL-proximal unit behavior incl. the
+bit-stability pin, atomic dump semantics, promotion gates, the retrain
+driver lifecycle (bootstrap / warm promote / FTRL promote / reject /
+rollback), GBDT warm-start quality vs a cold run, registry pin/rollback,
+the CLI subcommand, and the acceptance end-to-end: serve live traffic
+while a retrain lands — one version per batch, zero steady-state
+retraces across the swap, improved held-out loss after it.
+"""
+
+import json
+import math
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ytklearn_tpu import obs
+from ytklearn_tpu.config.params import CommonParams, GBDTParams
+from ytklearn_tpu.continual import (
+    RetrainRejected,
+    evaluate_gates,
+    read_version,
+    retrain,
+    rollback,
+)
+from ytklearn_tpu.continual.driver import _gbst_finished_trees
+from ytklearn_tpu.io.fs import LocalFileSystem, is_tmp_path
+
+N_FEATS = 8
+W_TRUE = np.random.RandomState(7).randn(N_FEATS)
+
+
+def _write_rows(path, n, seed, nonlinear=False):
+    """Synthetic `weight###label###k:v,...` rows from a fixed teacher.
+    Nonlinear (GBDT) rows also carry a one-of-4 sparse indicator block
+    (d0..d3, mutually exclusive by construction) so EFB forms a real
+    bundle on this data — the warm-start tests ride it."""
+    r = np.random.RandomState(seed)
+    with open(path, "w") as f:
+        for _ in range(n):
+            x = r.randn(N_FEATS)
+            s = x @ W_TRUE
+            feats = ",".join(f"c{i}:{x[i]:.5f}" for i in range(N_FEATS))
+            if nonlinear:
+                s += 1.5 * x[0] * x[1] - abs(x[2])
+                j = int(abs(x[3]) * 2.0) % 4
+                s += 0.4 * (j - 1.5)
+                feats += f",d{j}:1"
+            y = int(r.rand() < 1.0 / (1.0 + math.exp(-s)))
+            f.write(f"1###{y}###{feats}\n")
+
+
+@pytest.fixture(scope="module")
+def data_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("continual_data")
+    _write_rows(d / "d1.train", 600, 1)
+    _write_rows(d / "d2.train", 600, 2)
+    _write_rows(d / "holdout", 300, 3)
+    _write_rows(d / "g1.train", 400, 4, nonlinear=True)
+    _write_rows(d / "g2.train", 400, 5, nonlinear=True)
+    _write_rows(d / "gholdout", 200, 6, nonlinear=True)
+    with open(d / "gall.train", "w") as f:
+        f.write(open(d / "g1.train").read() + open(d / "g2.train").read())
+    return d
+
+
+def _linear_cfg(data_dir, model_path, train="d1.train", max_iter=10,
+                band=None):
+    cfg = {
+        "data": {
+            "train": {"data_path": str(data_dir / train)},
+            "test": {"data_path": str(data_dir / "holdout")},
+        },
+        "model": {"data_path": str(model_path)},
+        "loss": {"loss_function": "sigmoid",
+                 "regularization": {"l2": [0.001]}},
+        "optimization": {
+            "line_search": {"lbfgs": {"convergence": {"max_iter": max_iter}}}
+        },
+    }
+    if band is not None:
+        cfg["continual"] = {"band": band}
+    return cfg
+
+
+def _gbdt_cfg(data_dir, model_path, train, rounds, band=None):
+    cfg = {
+        "data": {
+            "train": {"data_path": str(data_dir / train)},
+            "test": {"data_path": str(data_dir / "gholdout")},
+            "max_feature_dim": N_FEATS + 4,  # + the one-of-4 d-block
+        },
+        "model": {"data_path": str(model_path)},
+        "loss": {"loss_function": "sigmoid"},
+        "optimization": {"round_num": rounds, "max_depth": 3,
+                         "learning_rate": 0.3},
+    }
+    if band is not None:
+        cfg["continual"] = {"band": band}
+    return cfg
+
+
+# ---------------------------------------------------------------------------
+# FTRL-proximal (optimize/ftrl.py)
+# ---------------------------------------------------------------------------
+
+
+class _QuadModel:
+    """Minimal model surface for ftrl_pass: weighted logistic loss."""
+
+    def __init__(self, dim):
+        self.dim = dim
+
+    def reg_vectors(self, l1, l2):
+        import jax.numpy as jnp
+
+        v = jnp.ones((self.dim,), jnp.float32)
+        return l1 * v, l2 * v
+
+    def pure_loss(self, w, X, y, weight):
+        import jax.numpy as jnp
+
+        z = X @ w
+        per = jnp.logaddexp(0.0, z) - y * z
+        return jnp.sum(weight * per)
+
+
+def _toy_batch(n=256, dim=6, seed=0):
+    r = np.random.RandomState(seed)
+    X = r.randn(n, dim).astype(np.float32)
+    w_t = r.randn(dim).astype(np.float32)
+    y = (r.rand(n) < 1.0 / (1.0 + np.exp(-(X @ w_t)))).astype(np.float32)
+    return X, y, np.ones(n, np.float32)
+
+
+def test_ftrl_init_inverts_closed_form():
+    """ftrl_init's z0 must make the very first weight solve reproduce the
+    checkpoint bit-for-bit — that IS the warm start."""
+    import jax.numpy as jnp
+
+    from ytklearn_tpu.optimize.ftrl import FTRLConfig, ftrl_init
+
+    w0 = jnp.asarray([0.5, -1.25, 0.0, 3.0], jnp.float32)
+    cfg = FTRLConfig(alpha=0.05, beta=1.0, l1=0.1, l2=0.01)
+    l1v = jnp.full((4,), cfg.l1, jnp.float32)
+    l2v = jnp.full((4,), cfg.l2, jnp.float32)
+    st = ftrl_init(w0, cfg, l1v, l2v)
+    # re-solve w from (z, n=0) with the update rule's closed form
+    denom = (cfg.beta + jnp.sqrt(st.n)) / cfg.alpha + l2v
+    w = jnp.where(
+        jnp.abs(st.z) <= l1v, 0.0,
+        -(st.z - jnp.sign(st.z) * l1v) / denom,
+    )
+    np.testing.assert_array_equal(np.asarray(w), np.asarray(w0))
+
+
+def test_ftrl_pass_learns_and_sparsifies():
+    from ytklearn_tpu.optimize.ftrl import FTRLConfig, ftrl_pass
+
+    X, y, wt = _toy_batch()
+    model = _QuadModel(X.shape[1])
+    import jax.numpy as jnp
+
+    w0 = np.zeros(X.shape[1], np.float32)
+    loss0 = float(model.pure_loss(jnp.asarray(w0), X, y, wt)) / len(y)
+    st = ftrl_pass(model, w0, (X, y, wt), FTRLConfig(alpha=0.5),
+                   batch_rows=32)
+    loss1 = float(model.pure_loss(st.w, X, y, wt)) / len(y)
+    assert loss1 < loss0 * 0.9
+    # heavy l1 -> sparsity
+    st_l1 = ftrl_pass(model, w0, (X, y, wt),
+                      FTRLConfig(alpha=0.5, l1=5.0), batch_rows=32)
+    assert int(np.sum(np.asarray(st_l1.w) != 0)) < X.shape[1]
+
+
+def test_ftrl_bit_stable_on_fixed_stream():
+    """Acceptance pin: the FTRL path is deterministic — two passes over the
+    same stream from the same state produce BIT-identical weights."""
+    from ytklearn_tpu.optimize.ftrl import FTRLConfig, ftrl_pass
+
+    X, y, wt = _toy_batch(seed=3)
+    model = _QuadModel(X.shape[1])
+    w0 = np.random.RandomState(5).randn(X.shape[1]).astype(np.float32)
+    cfg = FTRLConfig(alpha=0.2, beta=1.0, l1=0.05, l2=0.01)
+    a = ftrl_pass(model, w0, (X, y, wt), cfg, batch_rows=48)
+    b = ftrl_pass(model, w0, (X, y, wt), cfg, batch_rows=48)
+    np.testing.assert_array_equal(np.asarray(a.w), np.asarray(b.w))
+    np.testing.assert_array_equal(np.asarray(a.z), np.asarray(b.z))
+    np.testing.assert_array_equal(np.asarray(a.n), np.asarray(b.n))
+
+
+# ---------------------------------------------------------------------------
+# Atomic dumps (io/fs.py atomic_open / replace)
+# ---------------------------------------------------------------------------
+
+
+def test_atomic_open_commits_or_leaves_untouched(tmp_path):
+    fs = LocalFileSystem()
+    p = tmp_path / "m.txt"
+    p.write_text("old content\n")
+    with fs.atomic_open(str(p)) as f:
+        f.write("new content\n")
+    assert p.read_text() == "new content\n"
+    # failure mid-write: target untouched, no tmp debris
+    with pytest.raises(RuntimeError):
+        with fs.atomic_open(str(p)) as f:
+            f.write("half-writ")
+            raise RuntimeError("writer died")
+    assert p.read_text() == "new content\n"
+    assert [q.name for q in tmp_path.iterdir()] == ["m.txt"]
+
+
+def test_atomic_replace_across_dirs(tmp_path):
+    fs = LocalFileSystem()
+    src = tmp_path / "a" / "x.txt"
+    src.parent.mkdir()
+    src.write_text("payload")
+    dst = tmp_path / "b" / "sub" / "x.txt"  # parents do not exist yet
+    fs.replace(str(src), str(dst))
+    assert dst.read_text() == "payload" and not src.exists()
+
+
+def test_tmp_paths_excluded_from_loads_and_fingerprint(tmp_path):
+    """A crashed writer's tmp file must be invisible to model loaders and
+    to the serving fingerprint watcher."""
+    from ytklearn_tpu.predict import create_predictor
+    from ytklearn_tpu.serve.registry import model_fingerprint
+
+    d = tmp_path / "lr.model"
+    d.mkdir()
+    (d / "model-00000").write_text("c0,1.0,1.0\n_bias_,0.0\n")
+    cfg = {"model": {"data_path": str(d)},
+           "loss": {"loss_function": "sigmoid"}}
+    pred = create_predictor("linear", cfg)
+    fp = model_fingerprint(pred)
+    assert is_tmp_path(f"model-00000.tmp-123")
+    (d / "model-00000.tmp-123").write_text("c0,garbage-in-flight\n")
+    # loader skips it (weights unchanged), fingerprint ignores it
+    pred2 = create_predictor("linear", cfg)
+    assert pred2.score({"c0": 2.0}) == pred.score({"c0": 2.0})
+    assert model_fingerprint(pred2) == fp
+
+
+def test_trained_dump_has_no_tmp_residue(tmp_path, data_dir):
+    """Every trainer dump path goes through atomic_open now — a finished
+    train leaves zero `.tmp-` files anywhere under the model root."""
+    from ytklearn_tpu.train import HoagTrainer
+
+    cfg = _linear_cfg(data_dir, tmp_path / "lr.model", max_iter=3)
+    p = CommonParams.from_config(cfg)
+    HoagTrainer(p, "linear").train()
+    names = [f for f in os.listdir(tmp_path / "lr.model")]
+    assert names and not any(is_tmp_path(n) for n in names)
+
+
+# ---------------------------------------------------------------------------
+# Gates (continual/gates.py)
+# ---------------------------------------------------------------------------
+
+
+def test_gate_band_math():
+    ok = evaluate_gates(1.04, 1.0, 0.05, {})
+    assert ok.passed
+    bad = evaluate_gates(1.06, 1.0, 0.05, {})
+    assert not bad.passed and "outside the band" in bad.reasons[0]
+    # band 0 = must be no worse
+    assert evaluate_gates(1.0, 1.0, 0.0, {}).passed
+    assert not evaluate_gates(1.0 + 1e-6, 1.0, 0.0, {}).passed
+
+
+def test_gate_health_and_nan():
+    r = evaluate_gates(0.5, 1.0, 0.0, {"health.nan_loss": 1.0})
+    assert not r.passed and "health sentinels" in r.reasons[0]
+    r = evaluate_gates(float("nan"), 1.0, 0.0, {})
+    assert not r.passed and "non-finite" in r.reasons[0]
+    # no incumbent / no holdout -> metric gate passes vacuously
+    assert evaluate_gates(0.5, None, 0.0, {}).passed
+    assert evaluate_gates(None, None, 0.0, {}).passed
+
+
+def test_gbst_finished_trees_parse(tmp_path):
+    fs = LocalFileSystem()
+    d = tmp_path / "g.model"
+    d.mkdir()
+    (d / "tree-info").write_text("K:2\ntree_num:10\nfinished_tree_num:7\n")
+    assert _gbst_finished_trees(fs, str(d)) == 7
+    assert _gbst_finished_trees(fs, str(tmp_path / "absent")) == 0
+
+
+# ---------------------------------------------------------------------------
+# Retrain driver lifecycle (linear: bootstrap / promote / ftrl / reject /
+# rollback / archives / strict)
+# ---------------------------------------------------------------------------
+
+
+def _corrupt_weights(shadow_path):
+    for fn in os.listdir(shadow_path):
+        p = os.path.join(shadow_path, fn)
+        out = []
+        for ln in open(p).read().splitlines():
+            parts = ln.split(",")
+            parts[1] = "nan"
+            out.append(",".join(parts))
+        open(p, "w").write("\n".join(out) + "\n")
+
+
+def test_retrain_lifecycle_linear(tmp_path, data_dir):
+    fs = LocalFileSystem()
+    model = tmp_path / "lr.model"
+    # underfit bootstrap (2 L-BFGS iters) so warm retrains genuinely
+    # improve; a small band derisks the later FTRL step
+    cfg = _linear_cfg(data_dir, model, max_iter=2, band=0.02)
+
+    # bootstrap: no incumbent -> plain train, version 1
+    r1 = retrain("linear", cfg)
+    assert r1.promoted and r1.version == 1
+    assert r1.gate.incumbent_loss is None and r1.gate.passed
+    assert math.isfinite(r1.gate.candidate_loss)
+
+    # warm retrain on fresh data -> v2, measured against the incumbent
+    cfg = _linear_cfg(data_dir, model, train="d2.train", max_iter=12,
+                      band=0.02)
+    r2 = retrain("linear", cfg)
+    assert r2.promoted and r2.version == 2
+    assert r2.gate.candidate_loss < r2.gate.incumbent_loss
+    vinfo = read_version(fs, str(model))
+    assert vinfo["version"] == 2 and vinfo["archives"] == [1]
+    # shadow fully promoted away
+    assert not os.path.exists(str(model) + ".shadow")
+
+    # FTRL online pass -> v3
+    r3 = retrain("linear", cfg, mode="ftrl")
+    assert r3.promoted and r3.version == 3 and r3.mode == "ftrl"
+
+    # injected-NaN candidate -> rejected, incumbent untouched
+    before = open(sorted((model).iterdir())[0]).read()
+    r4 = retrain("linear", cfg, candidate_hook=_corrupt_weights)
+    assert not r4.promoted and r4.version == 3
+    assert "non-finite" in r4.gate.reasons[0]
+    assert open(sorted((model).iterdir())[0]).read() == before
+    # rejected JSON stays valid JSON (NaN loss -> null)
+    assert json.loads(json.dumps(r4.to_json()))["gate"]["candidate_loss"] is None
+    # the reject left the shadow for inspection + recorded the obs event
+    assert os.path.exists(str(model) + ".shadow")
+    assert obs.snapshot()["counters"].get("continual.rejected", 0) >= 1
+
+    # strict mode escalates the same rejection
+    os.environ["YTK_CONTINUAL_STRICT"] = "1"
+    try:
+        with pytest.raises(RetrainRejected):
+            retrain("linear", cfg, candidate_hook=_corrupt_weights)
+    finally:
+        del os.environ["YTK_CONTINUAL_STRICT"]
+
+    # archives pruned to YTK_CONTINUAL_KEEP (default 2): v1 dropped after
+    # v3's promotion archived v2
+    vinfo = read_version(fs, str(model))
+    assert vinfo["archives"] == [1, 2][-int(os.environ.get("YTK_CONTINUAL_KEEP", 2)):]
+
+    # rollback restores the newest archive (v2) over the live path
+    r5 = rollback("linear", cfg)
+    assert r5.rolled_back and r5.version == 2
+    vinfo = read_version(fs, str(model))
+    assert vinfo["version"] == 2 and vinfo["rolled_back_from"] == 3
+    # a second rollback reaches v1 (if still archived) or raises cleanly
+    archives = vinfo["archives"]
+    if archives:
+        r6 = rollback("linear", cfg)
+        assert r6.version == archives[-1]
+    else:
+        with pytest.raises(FileNotFoundError):
+            rollback("linear", cfg)
+
+
+def test_retrain_ftrl_rejected_for_gbdt(data_dir, tmp_path):
+    cfg = _gbdt_cfg(data_dir, tmp_path / "g.model", "g1.train", 3)
+    with pytest.raises(ValueError, match="convex-family"):
+        retrain("gbdt", cfg, mode="ftrl")
+
+
+# ---------------------------------------------------------------------------
+# GBDT warm start: N + k rounds from the checkpoint vs a cold N+k run
+# ---------------------------------------------------------------------------
+
+
+def test_gbdt_warm_start_matches_cold_quality(tmp_path, data_dir):
+    """Acceptance: warm-start GBDT (N rounds on old data, +k on new) must
+    land in the quality band of a cold N+k-round run over the union. The
+    g* fixture carries a one-of-4 sparse block, so EFB bundles in every
+    run here — the warm retrain therefore also rides the r11
+    EFB-under-continue_train fix (incumbent score replay on the transient
+    pre-bundle matrix, then bundled training), with no silent downgrade."""
+    from ytklearn_tpu.gbdt.trainer import GBDTTrainer
+
+    warm_model = tmp_path / "warm.model"
+    r1 = retrain("gbdt", _gbdt_cfg(data_dir, warm_model, "g1.train", 4),
+                 extra_rounds=3)
+    assert r1.promoted and r1.trained["trees"] == 4.0
+    bundles0 = obs.snapshot()["counters"].get("gbdt.efb.bundles", 0)
+    r2 = retrain("gbdt", _gbdt_cfg(data_dir, warm_model, "g2.train", 4),
+                 extra_rounds=3)
+    assert r2.promoted and r2.trained["trees"] == 7.0
+    # the warm candidate re-bundled (EFB stayed ON under continue_train)
+    counters = obs.snapshot()["counters"]
+    assert counters.get("gbdt.efb.bundles", 0) > bundles0
+    assert counters.get("gbdt.efb.downgrade", 0) == 0
+    warm_loss = r2.gate.candidate_loss
+
+    cold_tr = GBDTTrainer(GBDTParams.from_config(
+        _gbdt_cfg(data_dir, tmp_path / "cold.model", "gall.train", 7)
+    ))
+    cold = cold_tr.train()
+    assert cold_tr._efb_plan is not None  # the d-block really bundles
+    cold_loss = cold.test_loss
+    # same holdout files, same total rounds: warm must be in the band
+    assert warm_loss == pytest.approx(cold_loss, abs=0.06), (
+        f"warm {warm_loss} vs cold {cold_loss}"
+    )
+    # warm improved on the 4-round incumbent
+    assert warm_loss < r2.gate.incumbent_loss
+
+
+# ---------------------------------------------------------------------------
+# Registry pin / rollback (serve/registry.py)
+# ---------------------------------------------------------------------------
+
+
+def _write_linear_model(path, w):
+    path.write_text(f"c0,{w},1.0\n_bias_,0.0\n")
+
+
+def test_registry_pin_blocks_reload(tmp_path):
+    from ytklearn_tpu.serve import ModelRegistry
+
+    p = tmp_path / "m.model"
+    _write_linear_model(p, 1.0)
+    cfg = {"model": {"data_path": str(p)},
+           "loss": {"loss_function": "sigmoid"}}
+    reg = ModelRegistry(ladder=(1,), watch_interval_s=0)
+    reg.load("m", "linear", cfg)
+    reg.pin("m")
+    time.sleep(0.01)
+    _write_linear_model(p, 3.0)
+    assert reg.maybe_reload("m") is False  # pinned: fingerprint diff ignored
+    assert reg.get("m").version == 1
+    reg.unpin("m")
+    assert reg.maybe_reload("m") is True
+    assert reg.get("m").version == 2
+    with pytest.raises(KeyError):
+        reg.pin("ghost")
+    reg.close()
+
+
+def test_registry_rollback_swaps_and_pins(tmp_path):
+    from ytklearn_tpu.serve import ModelRegistry
+
+    p = tmp_path / "m.model"
+    _write_linear_model(p, 1.0)
+    cfg = {"model": {"data_path": str(p)},
+           "loss": {"loss_function": "sigmoid"}}
+    reg = ModelRegistry(ladder=(1,), watch_interval_s=0)
+    reg.load("m", "linear", cfg)
+    time.sleep(0.01)
+    _write_linear_model(p, 3.0)
+    assert reg.maybe_reload("m") is True
+    assert reg.get("m").scorer.score_batch([{"c0": 2.0}])[0] == 6.0
+    entry = reg.rollback("m")
+    assert entry.version == 1 and reg.pinned("m")
+    assert reg.get("m").scorer.score_batch([{"c0": 2.0}])[0] == 2.0
+    # pinned: the on-disk (bad) model does not come back by itself
+    assert reg.maybe_reload("m") is False
+    # rollback is itself undoable
+    entry = reg.rollback("m")
+    assert entry.version == 2
+    # no previous entry -> KeyError
+    reg2 = ModelRegistry(ladder=(1,), watch_interval_s=0)
+    reg2.load("m", "linear", cfg)
+    with pytest.raises(KeyError):
+        reg2.rollback("m")
+    reg.close()
+    reg2.close()
+
+
+def test_admin_endpoints_rollback_pin_unpin(tmp_path):
+    """The HTTP face of the serve-side handshake: /admin/rollback swaps
+    back and pins, /admin/pin//unpin control the watcher, /metrics
+    reports the pin."""
+    import urllib.error
+    import urllib.request
+
+    from ytklearn_tpu.serve import BatchPolicy, ModelRegistry, ServeApp
+
+    def _http(method, port, path, payload=None):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}",
+            data=json.dumps(payload).encode() if payload is not None else None,
+            headers={"Content-Type": "application/json"}, method=method,
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=10.0) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    p = tmp_path / "m.model"
+    _write_linear_model(p, 1.0)
+    cfg = {"model": {"data_path": str(p)},
+           "loss": {"loss_function": "sigmoid"}}
+    reg = ModelRegistry(ladder=(1,), watch_interval_s=0)
+    reg.load("m", "linear", cfg)
+    app = ServeApp(reg, BatchPolicy(max_batch=4, max_wait_ms=0.5)).start()
+    port = app.port
+    try:
+        # rollback before any reload: the model exists but has no previous
+        # version — a 409 state error, NOT the unknown-name 404
+        code, out = _http("POST", port, "/admin/rollback", {"model": "m"})
+        assert code == 409 and out["type"] == "no_previous_version"
+        time.sleep(0.01)
+        _write_linear_model(p, 3.0)
+        assert reg.maybe_reload("m") is True
+        code, out = _http("POST", port, "/admin/rollback", {"model": "m"})
+        assert code == 200 and out["version"] == 1 and out["pinned"]
+        assert reg.get("m").version == 1 and reg.pinned("m")
+        code, out = _http("GET", port, "/metrics")
+        assert out["models"]["m"]["pinned"] is True
+        code, out = _http("POST", port, "/admin/unpin", {"model": "m"})
+        assert code == 200 and out["pinned"] is False
+        code, out = _http("POST", port, "/admin/pin", {})  # default model
+        assert code == 200 and out["model"] == "m" and out["pinned"] is True
+        code, out = _http("POST", port, "/admin/rollback", {"model": "nope"})
+        assert code == 404 and out["type"] == "unknown_model"
+        # a typoed unpin must not 200 (it would silently leave the real
+        # model pinned and hot reload disabled)
+        code, out = _http("POST", port, "/admin/unpin", {"model": "typo"})
+        assert code == 404 and out["type"] == "unknown_model"
+        # non-object JSON bodies get the structured 400, not a traceback
+        code, out = _http("POST", port, "/admin/pin", [1, 2])
+        assert code == 400 and out["type"] == "bad_request"
+    finally:
+        app.stop(drain=True, timeout=10.0)
+
+
+def test_registry_defers_reload_when_files_change_midload(tmp_path):
+    """A multi-file promotion caught mid-move must not serve a blended
+    model: when the fingerprint moves during the warm load, the swap is
+    deferred to the next poll."""
+    from ytklearn_tpu.serve import ModelRegistry
+
+    p = tmp_path / "m.model"
+    _write_linear_model(p, 1.0)
+    cfg = {"model": {"data_path": str(p)},
+           "loss": {"loss_function": "sigmoid"}}
+    reg = ModelRegistry(ladder=(1,), watch_interval_s=0)
+    reg.load("m", "linear", cfg)
+    time.sleep(0.01)
+    _write_linear_model(p, 3.0)
+    orig_build = reg._build
+
+    def racing_build(*a, **k):
+        entry = orig_build(*a, **k)
+        time.sleep(0.01)
+        _write_linear_model(p, 5.0)  # the promotion is still moving files
+        return entry
+
+    reg._build = racing_build
+    assert reg.maybe_reload("m") is False  # deferred, incumbent serving
+    assert reg.get("m").version == 1
+    reg._build = orig_build
+    assert reg.maybe_reload("m") is True  # set settled -> clean swap
+    assert reg.get("m").scorer.score_batch([{"c0": 2.0}])[0] == 10.0
+    reg.close()
+
+
+# ---------------------------------------------------------------------------
+# Acceptance end-to-end: serve under traffic while a retrain lands
+# ---------------------------------------------------------------------------
+
+
+def test_freshness_e2e_under_traffic(tmp_path, data_dir):
+    """train -> serve -> retrain on new data -> health-gated promotion ->
+    hot swap under traffic: one version per batch, zero steady-state
+    retraces across the swap, improved held-out loss after it."""
+    from ytklearn_tpu.serve import BatchPolicy, ModelRegistry, ServeApp
+
+    model = tmp_path / "live.model"
+    # underfit bootstrap so the retrain reliably improves held-out loss
+    cfg = _linear_cfg(data_dir, model, max_iter=2)
+    r1 = retrain("linear", cfg)  # bootstrap v1
+    assert r1.promoted
+
+    reg = ModelRegistry(ladder=(1, 2, 4), watch_interval_s=0.05)
+    reg.load("m", "linear", cfg)
+    app = ServeApp(reg, BatchPolicy(max_batch=4, max_wait_ms=0.2))
+    reg.start_watching()
+
+    row = {f"c{i}": 0.5 for i in range(N_FEATS)}
+    # reference scores are captured at the hammer's batch size (rung 2):
+    # different ladder rungs are different compiled programs and may
+    # differ in the last ulp
+    v_score = {1: app.predict([row, row], timeout=10.0)["scores"][0]}
+    base = obs.snapshot()["counters"]
+    retr0 = base.get("health.retrace", 0)
+
+    stop = threading.Event()
+    bad, seen = [], set()
+
+    def hammer():
+        while not stop.is_set():
+            out = app.predict([row, row], timeout=10.0)
+            s, v = out["scores"], out["version"]
+            if s[0] != s[1]:
+                bad.append(("mixed batch", v, s))
+            seen.add((v, s[0]))
+
+    threads = [threading.Thread(target=hammer) for _ in range(3)]
+    for t in threads:
+        t.start()
+    try:
+        # the retrain lands IN-PROCESS while traffic flows
+        cfg2 = _linear_cfg(data_dir, model, train="d2.train", max_iter=12)
+        r2 = retrain("linear", cfg2)
+        assert r2.promoted and r2.version == 2
+        # improved held-out loss is what promotion certified
+        assert r2.gate.candidate_loss < r2.gate.incumbent_loss
+        # watcher picks the promoted model up under traffic
+        deadline = time.time() + 20.0
+        while reg.get("m").version == 1 and time.time() < deadline:
+            time.sleep(0.05)
+        assert reg.get("m").version == 2
+        out = app.predict([row, row], timeout=10.0)
+        assert out["version"] == 2
+        v_score[2] = out["scores"][0]
+        time.sleep(0.3)  # more traffic on v2
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10.0)
+        for b in app._batchers.values():
+            b.close(drain=True)
+        reg.close()
+
+    assert not bad, f"mixed-version batches: {bad[:3]}"
+    versions = {v for v, _ in seen}
+    assert versions == {1, 2}, f"served versions {versions}"
+    # every response's score matches its version's model exactly —
+    # a request never saw a half-swapped scorer
+    for v, s in seen:
+        assert s == v_score[v], (v, s, v_score[v])
+    # zero steady-state retraces across the whole swap: the retrain's own
+    # compiles were credited (serve/scorer.py compile_credit), and the
+    # serving path recompiled nothing
+    after = obs.snapshot()["counters"]
+    assert after.get("health.retrace", 0) == retr0
+    assert after.get("continual.promoted", 0) >= 1
+
+
+def test_freshness_e2e_rejection_keeps_incumbent(tmp_path, data_dir):
+    """The rejection path under serving: an injected-NaN candidate is
+    gated out, the registry never sees a fingerprint change, and the
+    incumbent keeps answering."""
+    from ytklearn_tpu.serve import BatchPolicy, ModelRegistry, ServeApp
+
+    model = tmp_path / "live.model"
+    cfg = _linear_cfg(data_dir, model)
+    assert retrain("linear", cfg).promoted
+
+    reg = ModelRegistry(ladder=(1, 2), watch_interval_s=0.05)
+    reg.load("m", "linear", cfg)
+    app = ServeApp(reg, BatchPolicy(max_batch=4, max_wait_ms=0.2))
+    reg.start_watching()
+    row = {f"c{i}": 0.5 for i in range(N_FEATS)}
+    s1 = app.predict([row], timeout=10.0)["scores"][0]
+    try:
+        cfg2 = _linear_cfg(data_dir, model, train="d2.train")
+        r = retrain("linear", cfg2, candidate_hook=_corrupt_weights)
+        assert not r.promoted
+        time.sleep(0.3)  # give the watcher time to (wrongly) react
+        assert reg.get("m").version == 1
+        out = app.predict([row], timeout=10.0)
+        assert out["version"] == 1 and out["scores"][0] == s1
+    finally:
+        for b in app._batchers.values():
+            b.close(drain=True)
+        reg.close()
+
+
+# ---------------------------------------------------------------------------
+# CLI: `python -m ytklearn_tpu.cli retrain` / --rollback / strict rc
+# ---------------------------------------------------------------------------
+
+
+def test_cli_retrain_and_rollback(tmp_path, data_dir, capsys):
+    from ytklearn_tpu.cli import retrain_main
+
+    conf = tmp_path / "lin.conf"
+    model = tmp_path / "cli.model"
+    conf.write_text(
+        'data {\n'
+        f'  train {{ data_path = "{data_dir / "d1.train"}" }}\n'
+        f'  test {{ data_path = "{data_dir / "holdout"}" }}\n'
+        '}\n'
+        f'model {{ data_path = "{model}" }}\n'
+        'loss { loss_function = "sigmoid" }\n'
+        'optimization { line_search { lbfgs { convergence '
+        '{ max_iter = 6 } } } }\n'
+        'continual { band = 0.05 }\n'
+    )
+    rc = retrain_main(["linear", str(conf)])
+    out1 = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0 and out1["promoted"] and out1["version"] == 1
+
+    rc = retrain_main([
+        "linear", str(conf), "--data", str(data_dir / "d2.train"),
+    ])
+    out2 = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0 and out2["promoted"] and out2["version"] == 2
+
+    rc = retrain_main(["linear", str(conf), "--rollback"])
+    out3 = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0 and out3["rolled_back"] and out3["version"] == 1

@@ -10,13 +10,16 @@ multi-host) instead of a TCP master.
 
 Console scripts (pyproject.toml):
   ytklearn-tpu-train   <model_name> <config_path> [options]
+  ytklearn-tpu-retrain <model_name> <config_path> [options]
   ytklearn-tpu-predict <config_path> <model_name> <file_dir> [options]
   ytklearn-tpu-serve   <config_path> <model_name> [options]
-plus `python -m ytklearn_tpu.cli {train,predict,convert,serve} ...`.
+plus `python -m ytklearn_tpu.cli {train,retrain,predict,convert,serve} ...`.
 
-`serve` has no reference counterpart (the reference stops at the
-thread-safe OnlinePredictor library); it fronts that API with the
-compiled-scorer + micro-batching online layer (docs/serving.md).
+`serve` and `retrain` have no reference counterpart (the reference stops
+at the thread-safe OnlinePredictor library): `serve` fronts that API with
+the compiled-scorer + micro-batching online layer (docs/serving.md), and
+`retrain` is the continuous-training driver feeding its hot-reload
+registry (docs/continual.md).
 """
 
 from __future__ import annotations
@@ -343,6 +346,86 @@ def convert_main(argv: Optional[List[str]] = None) -> int:
     return 0
 
 
+def retrain_main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="ytklearn-tpu-retrain",
+        description="Continuous training driver: warm-start a candidate on "
+        "new data in a shadow path, validate it against the health gates "
+        "and a held-out metric band versus the serving incumbent, and "
+        "atomically promote only on pass — the serving registry's "
+        "fingerprint watcher hot-swaps the promoted model under traffic "
+        "(docs/continual.md)",
+    )
+    ap.add_argument("model_name", choices=MODEL_NAMES)
+    ap.add_argument("config_path")
+    ap.add_argument("--data", default="",
+                    help="fresh training data path(s) (comma-separated); "
+                    "overrides data.train.data_path")
+    ap.add_argument("--test", default="",
+                    help="held-out data path(s) for the metric gate; "
+                    "overrides data.test.data_path")
+    ap.add_argument("--mode", default="", choices=("", "warm", "ftrl"),
+                    help="warm = full warm-start refit (default); ftrl = "
+                    "one FTRL-proximal online pass (convex families)")
+    ap.add_argument("--extra-rounds", type=int, default=-1,
+                    help="extra boosting rounds for GBDT/GBST warm starts "
+                    "(default: continual.extra_rounds)")
+    ap.add_argument("--rollback", action="store_true",
+                    help="restore the newest archived version over the "
+                    "served path instead of retraining")
+    ap.add_argument("--transform", action="store_true",
+                    help="enable the python line-transform hook")
+    ap.add_argument("--transform-script", default="bin/transform.py")
+    ap.add_argument("--devices", type=int, default=0,
+                    help="mesh size (default: all local devices)")
+    ap.add_argument("--set", action="append", dest="sets", metavar="KEY=VALUE",
+                    help="config override, repeatable")
+    ap.add_argument("--trace-out", default="",
+                    help="write a Chrome-trace/Perfetto JSON of the retrain")
+    ap.add_argument("--verbose", action="store_true")
+    args = ap.parse_args(argv)
+    _setup_logging(args.verbose)
+    _setup_trace(args.trace_out)
+
+    from .config import hocon
+    from .continual import RetrainRejected, retrain, rollback
+
+    cfg = _apply_overrides(hocon.load(args.config_path), args.sets)
+    if args.data:
+        cfg = hocon.set_path(cfg, "data.train.data_path", args.data)
+    if args.test:
+        cfg = hocon.set_path(cfg, "data.test.data_path", args.test)
+
+    if args.rollback:
+        res = rollback(args.model_name, cfg)
+        _flush_trace(args.trace_out)
+        print(json.dumps(res.to_json()))
+        return 0
+
+    mesh = _make_mesh(args.devices)
+    hook = _load_hook(args.transform, args.transform_script)
+    try:
+        res = retrain(
+            args.model_name, cfg, mesh=mesh,
+            mode=args.mode or None,
+            extra_rounds=args.extra_rounds if args.extra_rounds >= 0 else None,
+            transform_hook=hook,
+        )
+    except RetrainRejected as e:
+        # YTK_CONTINUAL_STRICT=1: a rejection is a hard failure for the
+        # surrounding pipeline, but still a clean JSON record on stdout
+        print(json.dumps({
+            "promoted": False,
+            "strict": True,
+            "reasons": e.report.reasons,
+        }))
+        _flush_trace(args.trace_out)
+        return 1
+    _flush_trace(args.trace_out)
+    print(json.dumps(res.to_json()))
+    return 0
+
+
 def serve_main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="ytklearn-tpu-serve",
@@ -419,18 +502,22 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
 def main(argv: Optional[List[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help"):
-        print("usage: python -m ytklearn_tpu.cli {train,predict,convert,serve} ...")
+        print("usage: python -m ytklearn_tpu.cli "
+              "{train,retrain,predict,convert,serve} ...")
         return 0 if argv else 2
     cmd, rest = argv[0], argv[1:]
     if cmd == "train":
         return train_main(rest)
+    if cmd == "retrain":
+        return retrain_main(rest)
     if cmd == "predict":
         return predict_main(rest)
     if cmd == "convert":
         return convert_main(rest)
     if cmd == "serve":
         return serve_main(rest)
-    print(f"unknown command {cmd!r}; expected train|predict|convert|serve", file=sys.stderr)
+    print(f"unknown command {cmd!r}; expected "
+          "train|retrain|predict|convert|serve", file=sys.stderr)
     return 2
 
 

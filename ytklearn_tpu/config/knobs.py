@@ -153,6 +153,19 @@ _knob("YTK_FLIGHT_DIR", "str", "flight_dumps",
       "flight-dump directory (default: `flight_dumps/`, which is "
       "gitignored — a crash dump must never end up committed)")
 
+# -- continual training -----------------------------------------------------
+_knob("YTK_CONTINUAL_BAND", "float", 0.0,
+      "relative held-out-loss tolerance for retrain promotion: a candidate "
+      "passes the metric gate when loss <= incumbent * (1 + band); 0 = "
+      "must be no worse (config `continual.band` overrides per run)")
+_knob("YTK_CONTINUAL_KEEP", "int", 2,
+      "archived incumbent versions kept next to the model path for "
+      "`ytklearn-tpu retrain --rollback`")
+_knob("YTK_CONTINUAL_STRICT", "bool", False,
+      "escalate a rejected retrain candidate to a non-zero exit "
+      "(unattended freshness pipelines; default records the rejection "
+      "and keeps the incumbent)")
+
 # -- serving ----------------------------------------------------------------
 _knob("YTK_SERVE_LADDER", "str", None,
       "serving batch-shape ladder, e.g. `1,8,64,512` "

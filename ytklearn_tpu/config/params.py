@@ -285,6 +285,36 @@ class HyperParams:
 
 
 @dataclass
+class ContinualParams:
+    """Continuous-training block (`continual.*`; no reference counterpart —
+    the reference's serving story was retrain-offline + restart). Drives
+    the `ytklearn-tpu retrain` driver (docs/continual.md)."""
+
+    mode: str = "warm"  # warm (full warm-start refit) | ftrl (online pass)
+    extra_rounds: int = 10  # extra boosting rounds per GBDT/GBST retrain
+    band: float = -1.0  # held-out loss tolerance; < 0 -> YTK_CONTINUAL_BAND
+    # FTRL-proximal hyperparameters (McMahan et al., KDD 2013 — PAPERS.md)
+    ftrl_alpha: float = 0.1
+    ftrl_beta: float = 1.0
+    ftrl_l1: float = 0.0
+    ftrl_l2: float = 0.0
+    batch_rows: int = 8192  # streaming minibatch rows for the FTRL pass
+
+    @classmethod
+    def from_config(cls, cfg: dict) -> "ContinualParams":
+        return cls(
+            mode=str(_opt(cfg, "continual.mode", "warm")),
+            extra_rounds=int(_opt(cfg, "continual.extra_rounds", 10)),
+            band=float(_opt(cfg, "continual.band", -1.0)),
+            ftrl_alpha=float(_opt(cfg, "continual.ftrl.alpha", 0.1)),
+            ftrl_beta=float(_opt(cfg, "continual.ftrl.beta", 1.0)),
+            ftrl_l1=float(_opt(cfg, "continual.ftrl.l1", 0.0)),
+            ftrl_l2=float(_opt(cfg, "continual.ftrl.l2", 0.0)),
+            batch_rows=int(_opt(cfg, "continual.batch_rows", 8192)),
+        )
+
+
+@dataclass
 class RandomParams:
     """Latent-factor init distributions (reference: param/RandomParams.java:40)."""
 
@@ -321,6 +351,7 @@ class CommonParams:
     line_search: LineSearchParams = field(default_factory=LineSearchParams)
     hyper: HyperParams = field(default_factory=HyperParams)
     random: RandomParams = field(default_factory=RandomParams)
+    continual: ContinualParams = field(default_factory=ContinualParams)
 
     # model-specific root-level scalars
     k: Any = None  # int (multiclass/gbst) or [use_first_order, dim] (fm/ffm)
@@ -348,6 +379,7 @@ class CommonParams:
             line_search=LineSearchParams.from_config(cfg),
             hyper=HyperParams.from_config(cfg),
             random=RandomParams.from_config(cfg),
+            continual=ContinualParams.from_config(cfg),
             k=_opt(cfg, "k", None),
             bias_need_latent_factor=bool(_opt(cfg, "bias_need_latent_factor", False)),
             instance_sample_rate=float(_opt(cfg, "instance_sample_rate", 1.0)),
@@ -419,6 +451,7 @@ class GBDTParams:
     gbdt_type: str = "gradient_boosting"  # gradient_boosting | random_forest
     data: DataParams = field(default_factory=DataParams)
     model: ModelParams = field(default_factory=ModelParams)
+    continual: ContinualParams = field(default_factory=ContinualParams)
 
     # optimization block
     tree_maker: str = "data"  # data | feature
@@ -471,6 +504,7 @@ class GBDTParams:
             gbdt_type=str(_opt(cfg, "type", "gradient_boosting")),
             data=DataParams.from_config(cfg),
             model=ModelParams.from_config(cfg),
+            continual=ContinualParams.from_config(cfg),
             tree_maker=str(_opt(cfg, f"{o}.tree_maker", "data")),
             tree_grow_policy=str(_opt(cfg, f"{o}.tree_grow_policy", "level")),
             round_num=int(_opt(cfg, f"{o}.round_num", 50)),

@@ -1,0 +1,55 @@
+"""ytklearn_tpu.continual — continuous/incremental training (docs/continual.md).
+
+The subsystem that closes the train->serve loop: the r9 serving registry
+can warm-and-swap a new model under live traffic, and this package is
+what *produces* those models:
+
+  retrain()        warm-start a candidate on new data in a shadow path
+                   (GBDT: +extra_rounds boosting rounds on the loaded
+                   ensemble; convex: L-BFGS from checkpoint weights, or
+                   an FTRL-proximal online pass), gate it on the r8
+                   health sentinels + a held-out metric band versus the
+                   incumbent, and atomically promote only on pass —
+                   rejects keep the incumbent serving and record a
+                   `continual.rejected` obs event
+  rollback()       restore the newest archived incumbent over the live
+                   path (the disk-level undo; `ModelRegistry.rollback()`
+                   is the in-memory twin)
+  gates            health/metric gate evaluation + held-out loss scoring
+  ftrl_update_convex  the streaming FTRL arm (optimize/ftrl.py)
+
+CLI: `python -m ytklearn_tpu.cli retrain <model> <conf>` /
+`ytklearn-tpu-retrain`. Knobs: YTK_CONTINUAL_BAND / _KEEP / _STRICT.
+"""
+
+from __future__ import annotations
+
+from .driver import (  # noqa: F401
+    RetrainRejected,
+    RetrainResult,
+    read_version,
+    retrain,
+    rollback,
+)
+from .gates import (  # noqa: F401
+    GateReport,
+    evaluate_gates,
+    health_counters,
+    health_delta,
+    holdout_loss,
+)
+from .online import ftrl_update_convex  # noqa: F401
+
+__all__ = [
+    "GateReport",
+    "RetrainRejected",
+    "RetrainResult",
+    "evaluate_gates",
+    "ftrl_update_convex",
+    "health_counters",
+    "health_delta",
+    "holdout_loss",
+    "read_version",
+    "retrain",
+    "rollback",
+]

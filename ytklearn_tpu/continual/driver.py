@@ -1,0 +1,630 @@
+"""The `ytklearn-tpu retrain` driver — close the train->serve loop.
+
+One call does the whole freshness cycle (docs/continual.md):
+
+  1. SHADOW    copy the serving incumbent's files to `<data_path>.shadow*`
+               and warm-start a candidate there — GBDT grows
+               `continual.extra_rounds` more boosting rounds on the new
+               data via the existing tree-ascending accumulation, the
+               convex families either refit L-BFGS from the checkpoint
+               weights (`mode=warm`) or stream one FTRL-proximal pass
+               over the fresh rows (`mode=ftrl`, optimize/ftrl.py); the
+               live model keeps serving untouched throughout.
+  2. GATE      r8 health sentinels must stay silent over the candidate
+               run AND the candidate's held-out loss must sit inside the
+               band versus the incumbent, both measured now on the same
+               held-out files (continual/gates.py).
+  3. PROMOTE   on pass, archive the incumbent to `<data_path>.v<N>` (for
+               `retrain --rollback`), move every candidate file over the
+               live path with atomic per-file replaces, and stamp
+               `<data_path>.version.json` — the serving registry's
+               fingerprint watcher picks the new version up and
+               warm-swaps it under traffic (serve/registry.py). On fail,
+               the incumbent keeps serving, the shadow is left for
+               inspection, and a `continual.rejected` obs event names
+               every failed gate.
+
+No reference counterpart: the reference retrains offline and restarts its
+predictors; Clipper's model abstraction (PAPERS.md) assumes exactly this
+kind of supply of freshly trained versions behind the serving API.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import math
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..config import hocon, knobs
+from ..config.params import CommonParams, GBDTParams
+from ..io.fs import FileSystem, create_filesystem, is_tmp_path
+from ..obs import (
+    configure as obs_configure,
+    enabled as obs_enabled,
+    event as obs_event,
+    inc as obs_inc,
+    span as obs_span,
+)
+from ..predict import create_predictor
+from .gates import GateReport, evaluate_gates, health_counters, health_delta, holdout_loss
+
+log = logging.getLogger("ytklearn_tpu.continual")
+
+GBST_NAMES = ("gbmlr", "gbsdt", "gbhmlr", "gbhsdt")
+CONVEX_NAMES = ("linear", "multiclass_linear", "fm", "ffm")
+
+SHADOW_SUFFIX = ".shadow"
+VERSION_SUFFIX = ".version.json"
+LOCK_SUFFIX = ".retrain.lock"
+
+
+class RetrainRejected(RuntimeError):
+    """A gated candidate failed promotion under YTK_CONTINUAL_STRICT=1;
+    carries the gate report."""
+
+    def __init__(self, report: GateReport):
+        super().__init__(
+            "retrain candidate rejected: " + "; ".join(report.reasons)
+        )
+        self.report = report
+
+
+@dataclass
+class RetrainResult:
+    promoted: bool
+    version: int  # serving version after the call
+    gate: Optional[GateReport] = None
+    model_path: str = ""
+    shadow_path: str = ""
+    mode: str = "warm"
+    trained: Dict[str, float] = field(default_factory=dict)  # family metrics
+    rolled_back: bool = False
+
+    def to_json(self) -> dict:
+        def _finite(v):
+            # stdlib json emits bare NaN/Infinity, which is not JSON —
+            # a rejected candidate's losses are exactly where they appear
+            return v if v is None or math.isfinite(v) else None
+
+        out = {
+            "promoted": self.promoted,
+            "version": self.version,
+            "model_path": self.model_path,
+            "mode": self.mode,
+            "rolled_back": self.rolled_back,
+        }
+        if self.gate is not None:
+            out["gate"] = {
+                "passed": self.gate.passed,
+                "reasons": self.gate.reasons,
+                "candidate_loss": _finite(self.gate.candidate_loss),
+                "incumbent_loss": _finite(self.gate.incumbent_loss),
+                "band": self.gate.band,
+                "holdout_rows": self.gate.holdout_rows,
+            }
+        if self.trained:
+            out["trained"] = {k: _finite(v) for k, v in self.trained.items()}
+        return out
+
+
+# ---------------------------------------------------------------------------
+# File plumbing: every model family dumps under model.data_path plus a
+# fixed set of sidecar roots; shadow/archive/promote move those trees as
+# one unit, file by file, with atomic per-file replaces.
+# ---------------------------------------------------------------------------
+
+
+def _roots(data_path: str) -> Dict[str, str]:
+    """The file roots a dumped model can span (missing ones are skipped):
+    main tree (file or directory), the dict sidecar dir, the transform
+    stat sidecar."""
+    return {
+        "": data_path,
+        "_dict": data_path + "_dict",
+        "_feature_transform_stat": data_path + "_feature_transform_stat",
+    }
+
+
+def _files_under(fs: FileSystem, root: str) -> List[str]:
+    if not fs.exists(root):
+        return []
+    return [p for p in sorted(fs.recur_get_paths([root])) if not is_tmp_path(p)]
+
+
+def _rel(root: str, path: str) -> str:
+    """'' when path IS the root file, else the '/'-relative suffix."""
+    if path == root:
+        return ""
+    root = root.rstrip("/")
+    if not path.startswith(root + "/"):
+        raise ValueError(f"{path!r} is not under {root!r}")
+    return path[len(root):]
+
+
+def _copy_file(fs: FileSystem, src: str, dst: str) -> None:
+    # chunked: a GBDT dump with stats can run to hundreds of MB, and
+    # retrain copies the incumbent twice (shadow + archive)
+    with fs.open(src) as sf, fs.atomic_open(dst) as df:
+        while True:
+            chunk = sf.read(1 << 20)
+            if not chunk:
+                break
+            df.write(chunk)
+
+
+def _copy_roots(fs: FileSystem, src_base: str, dst_base: str) -> int:
+    """Copy every model file from the src root set to the dst root set;
+    returns the file count."""
+    n = 0
+    for suffix, src_root in _roots(src_base).items():
+        dst_root = _roots(dst_base)[suffix]
+        for path in _files_under(fs, src_root):
+            _copy_file(fs, path, dst_root + _rel(src_root, path))
+            n += 1
+    return n
+
+
+def _promote_roots(fs: FileSystem, src_base: str, dst_base: str) -> int:
+    """MOVE every candidate file over the live path (atomic per-file
+    replace), then drop the emptied shadow roots."""
+    n = 0
+    for suffix, src_root in _roots(src_base).items():
+        dst_root = _roots(dst_base)[suffix]
+        for path in _files_under(fs, src_root):
+            fs.replace(path, dst_root + _rel(src_root, path))
+            n += 1
+        if fs.exists(src_root):
+            fs.delete(src_root)  # now-empty shadow dir (or stale file)
+    return n
+
+
+def _delete_roots(fs: FileSystem, base: str) -> None:
+    for root in _roots(base).values():
+        if fs.exists(root):
+            fs.delete(root)
+
+
+def _restore_roots(fs: FileSystem, src_base: str, dst_base: str) -> int:
+    """MOVE every archive file over the live path, then prune live files
+    the archive does not carry (e.g. a longer ensemble's extra tree
+    dirs). Restore-over-then-prune instead of delete-then-move: at no
+    point is the live path without a complete model on disk — a crash
+    mid-restore leaves every file whole and a re-run converges."""
+    n = 0
+    for suffix, src_root in _roots(src_base).items():
+        dst_root = _roots(dst_base)[suffix]
+        restored = set()
+        for path in _files_under(fs, src_root):
+            rel = _rel(src_root, path)
+            fs.replace(path, dst_root + rel)
+            restored.add(rel)
+            n += 1
+        for path in _files_under(fs, dst_root):
+            if _rel(dst_root, path) not in restored:
+                fs.delete(path)
+        if fs.exists(src_root):
+            fs.delete(src_root)  # now-empty archive dir (or stale file)
+    return n
+
+
+# ---------------------------------------------------------------------------
+# Version sidecar — `<data_path>.version.json`: the promotion record the
+# serving registry fingerprints (so even a content-identical re-promotion
+# triggers a reload) and `--rollback` reads.
+# ---------------------------------------------------------------------------
+
+
+def read_version(fs: FileSystem, data_path: str) -> dict:
+    path = data_path + VERSION_SUFFIX
+    if not fs.exists(path):
+        return {"version": 1, "archives": []}
+    try:
+        with fs.open(path) as f:
+            return json.load(f)
+    except (json.JSONDecodeError, OSError):
+        log.warning("unreadable version sidecar %s; starting at v1", path)
+        return {"version": 1, "archives": []}
+
+
+def _write_version(fs: FileSystem, data_path: str, info: dict) -> None:
+    with fs.atomic_open(data_path + VERSION_SUFFIX) as f:
+        json.dump(info, f, indent=1)
+
+
+# ---------------------------------------------------------------------------
+# The driver
+# ---------------------------------------------------------------------------
+
+
+def _eval_cfg(cfg: dict, family: str) -> dict:
+    """Config for gate-time holdout scoring: uncap `optimization.round_num`
+    so the predictor scores the WHOLE dumped ensemble — the training cap
+    names how many rounds to grow, not how many the gate may see (the
+    GBDT predictor serves min(dumped, round_num) when the cap is > 0)."""
+    if family != "gbdt":
+        return cfg
+    out = json.loads(json.dumps(cfg))
+    hocon.set_path(out, "optimization.round_num", 0)
+    return out
+
+
+def _family(model_name: str) -> str:
+    if model_name == "gbdt":
+        return "gbdt"
+    if model_name in GBST_NAMES:
+        return "gbst"
+    if model_name in CONVEX_NAMES:
+        return "convex"
+    raise ValueError(f"unknown model name {model_name!r}")
+
+
+def _gbdt_incumbent_rounds(fs: FileSystem, p: GBDTParams) -> int:
+    from ..gbdt.tree import GBDTModel
+
+    with fs.open(p.model.data_path) as f:
+        model = GBDTModel.loads(f.read())
+    return len(model.trees) // max(p.num_tree_in_group, 1)
+
+
+def _gbst_finished_trees(fs: FileSystem, data_path: str) -> int:
+    path = f"{data_path}/tree-info"
+    if not fs.exists(path):
+        return 0
+    with fs.open(path) as f:
+        for line in f:
+            if line.startswith("finished_tree_num:"):
+                return int(float(line.split(":", 1)[1]))
+    return 0
+
+
+def _train_candidate(
+    model_name: str, family: str, cfg: dict, fs: FileSystem, mesh,
+    mode: str, transform_hook,
+) -> Dict[str, float]:
+    """Run the warm-start (or FTRL) candidate training against the shadow
+    config; returns the family's summary metrics for the result JSON."""
+    if family == "gbdt":
+        from ..gbdt.data import GBDTIngest
+        from ..gbdt.trainer import GBDTTrainer
+
+        p = GBDTParams.from_config(cfg)
+        train, test = GBDTIngest(
+            p, fs=fs, transform_hook=transform_hook
+        ).load()
+        res = GBDTTrainer(p, mesh=mesh, fs=fs).train(train=train, test=test)
+        return {
+            "trees": float(len(res.model.trees)),
+            "train_loss": res.train_loss,
+            **({"test_loss": res.test_loss} if res.test_loss is not None else {}),
+        }
+    if family == "gbst":
+        from ..boost import GBSTTrainer
+        from ..io.reader import DataIngest
+
+        p = CommonParams.from_config(cfg)
+        ingest = DataIngest(p, fs=fs, transform_hook=transform_hook).load()
+        res = GBSTTrainer(p, model_name, mesh=mesh, fs=fs).train(ingest=ingest)
+        return {
+            "trees": float(res.n_trees),
+            "train_loss": res.train_loss,
+            **({"test_loss": res.test_loss} if res.test_loss is not None else {}),
+        }
+    # convex families
+    from ..train import HoagTrainer
+
+    p = CommonParams.from_config(cfg)
+    trainer = HoagTrainer(
+        p, model_name, mesh=mesh, fs=fs, transform_hook=transform_hook
+    )
+    if mode == "ftrl":
+        from .online import ftrl_update_convex
+
+        return ftrl_update_convex(trainer, p)
+    res = trainer.train()
+    return {
+        "n_iter": float(res.n_iter),
+        "avg_loss": res.avg_loss,
+        **({"test_loss": res.test_loss} if res.test_loss is not None else {}),
+    }
+
+
+def retrain(
+    model_name: str,
+    cfg: dict,
+    fs: Optional[FileSystem] = None,
+    mesh=None,
+    mode: Optional[str] = None,
+    extra_rounds: Optional[int] = None,
+    transform_hook: Optional[Callable] = None,
+    candidate_hook: Optional[Callable[[str], None]] = None,
+) -> RetrainResult:
+    """Train a warm-started candidate on the config's (new) data, gate it
+    against the incumbent, and atomically promote on pass.
+
+    `cfg` is the parsed training config whose `model.data_path` names the
+    SERVING model; `data.train.data_path` should point at the fresh data.
+    `candidate_hook(shadow_data_path)` runs after candidate training and
+    before gating — the canary seam (tests inject a corrupted candidate
+    through it). Raises RetrainRejected instead of returning a rejected
+    result when YTK_CONTINUAL_STRICT=1.
+    """
+    family = _family(model_name)
+    fs = fs or create_filesystem(str(cfg.get("fs_scheme", "local")))
+    params = (
+        GBDTParams.from_config(cfg) if family == "gbdt"
+        else CommonParams.from_config(cfg)
+    )
+    # one retrain at a time per serving model: overlapping runs (e.g.
+    # cron-driven) would share the same shadow path, and the second run's
+    # shadow reset could hand the first run's gate a half-trained
+    # candidate to promote
+    lock_path = params.model.data_path + LOCK_SUFFIX
+    if fs.exists(lock_path):
+        raise RuntimeError(
+            f"another retrain holds {lock_path}; if its process is gone, "
+            "delete the stale lock file and re-run"
+        )
+    with fs.atomic_open(lock_path) as f:
+        f.write(f"pid={os.getpid()} t={time.time():.0f}\n")
+    obs_was_enabled = obs_enabled()
+    if not obs_was_enabled:
+        # the health gate reads sentinel counter deltas; collection must be
+        # on for the candidate run (export stays un-configured)
+        obs_configure(enabled=True)
+    try:
+        return _retrain_locked(
+            model_name, family, params, cfg, fs, mesh, mode, extra_rounds,
+            transform_hook, candidate_hook,
+        )
+    finally:
+        if not obs_was_enabled:
+            # scoped enable: a YTK_OBS=0 operator's embedding process must
+            # not keep accumulating spans/events after the retrain returns
+            obs_configure(enabled=False)
+        if fs.exists(lock_path):
+            fs.delete(lock_path)
+
+
+def _retrain_locked(
+    model_name: str,
+    family: str,
+    params,
+    cfg: dict,
+    fs: FileSystem,
+    mesh,
+    mode: Optional[str],
+    extra_rounds: Optional[int],
+    transform_hook: Optional[Callable],
+    candidate_hook: Optional[Callable[[str], None]],
+) -> RetrainResult:
+    t0 = time.time()
+    cp = params.continual
+    mode = mode or cp.mode
+    if mode not in ("warm", "ftrl"):
+        raise ValueError(f"continual.mode must be warm|ftrl, got {mode!r}")
+    if mode == "ftrl" and family != "convex":
+        raise ValueError(
+            f"mode=ftrl is a convex-family online path; {model_name} "
+            "retrains with mode=warm (boosting is already incremental)"
+        )
+    extra = cp.extra_rounds if extra_rounds is None else int(extra_rounds)
+    band = cp.band if cp.band >= 0 else knobs.get_float("YTK_CONTINUAL_BAND")
+
+    data_path = params.model.data_path
+    shadow_path = data_path + SHADOW_SUFFIX
+    test_paths = list(params.data.test_paths)
+    incumbent = fs.exists(data_path)
+    vinfo = read_version(fs, data_path)
+    version = int(vinfo.get("version", 1))
+
+    # XLA compiles below (candidate training, holdout scoring) are
+    # expected work, not serving retraces: when serving runs in the same
+    # process, credit them so armed CompiledScorers keep their
+    # zero-steady-state-retrace contract (serve/scorer.py)
+    from ..serve.scorer import compile_credit
+
+    # ---- incumbent held-out loss (measured NOW, on the same files) ------
+    incumbent_loss: Optional[float] = None
+    if incumbent and test_paths:
+        with compile_credit():
+            incumbent_loss, _ = holdout_loss(
+                create_predictor(model_name, _eval_cfg(cfg, family), fs),
+                test_paths,
+            )
+    elif not test_paths:
+        log.warning(
+            "no data.test.data_path configured: the metric gate cannot "
+            "compare candidate vs incumbent — promotion rides the health "
+            "gate alone"
+        )
+
+    # ---- shadow warm start ----------------------------------------------
+    _delete_roots(fs, shadow_path)  # stale shadow from an aborted run
+    shadow_cfg = json.loads(json.dumps(cfg))  # deep copy; configs are JSON-shaped
+    hocon.set_path(shadow_cfg, "model.data_path", shadow_path)
+    fi_path = params.model.feature_importance_path
+    if fi_path:
+        # candidate training must not clobber the live importance sidecar:
+        # a rejected candidate would leave it describing an ensemble that
+        # never served; promoted candidates move theirs over at promote
+        if fs.exists(fi_path + SHADOW_SUFFIX):
+            fs.delete(fi_path + SHADOW_SUFFIX)
+        hocon.set_path(
+            shadow_cfg, "model.feature_importance_path",
+            fi_path + SHADOW_SUFFIX,
+        )
+    if incumbent:
+        with obs_span("continual.shadow_copy"):
+            n_copied = _copy_roots(fs, data_path, shadow_path)
+        log.info(
+            "retrain: shadow-copied incumbent v%d (%d files) -> %s",
+            version, n_copied, shadow_path,
+        )
+        hocon.set_path(shadow_cfg, "model.continue_train", True)
+        if family == "gbdt":
+            rounds = _gbdt_incumbent_rounds(fs, params) + extra
+            hocon.set_path(shadow_cfg, "optimization.round_num", rounds)
+            log.info("retrain: gbdt warm start -> %d total rounds", rounds)
+        elif family == "gbst":
+            trees = _gbst_finished_trees(fs, data_path) + extra
+            hocon.set_path(shadow_cfg, "tree_num", trees)
+            log.info("retrain: gbst warm start -> %d total trees", trees)
+    else:
+        log.info("retrain: no incumbent at %s — bootstrap training", data_path)
+        hocon.set_path(shadow_cfg, "model.continue_train", False)
+
+    health_before = health_counters()
+    obs_inc("continual.retrains")
+    with obs_span("continual.train_candidate", mode=mode, model=model_name):
+        with compile_credit():
+            trained = _train_candidate(
+                model_name, family, shadow_cfg, fs, mesh, mode, transform_hook
+            )
+    if candidate_hook is not None:
+        candidate_hook(shadow_path)
+
+    # ---- gates ----------------------------------------------------------
+    candidate_loss: Optional[float] = None
+    holdout_rows = 0
+    if test_paths:
+        with compile_credit():
+            candidate_loss, holdout_rows = holdout_loss(
+                create_predictor(model_name, _eval_cfg(shadow_cfg, family), fs),
+                test_paths,
+            )
+    health_hits = health_delta(health_before)
+    # health.retrace is a SERVING-health signal: candidate training can't
+    # fire it (its compiles ride compile_credit), but an in-process
+    # server's RetraceSentinel can during this window — that's the
+    # server's problem to report, not a fact about the candidate
+    health_hits.pop("health.retrace", None)
+    gate = evaluate_gates(
+        candidate_loss, incumbent_loss, band, health_hits, holdout_rows,
+    )
+
+    if not gate.passed:
+        obs_inc("continual.rejected")
+        obs_event(
+            "continual.rejected",
+            model=model_name,
+            reasons="; ".join(gate.reasons),
+            candidate_loss=gate.candidate_loss,
+            incumbent_loss=gate.incumbent_loss,
+        )
+        log.warning(
+            "retrain REJECTED (incumbent v%d keeps serving): %s "
+            "(candidate left at %s for inspection)",
+            version, "; ".join(gate.reasons), shadow_path,
+        )
+        result = RetrainResult(
+            promoted=False, version=version, gate=gate,
+            model_path=data_path, shadow_path=shadow_path, mode=mode,
+            trained=trained,
+        )
+        if knobs.get_bool("YTK_CONTINUAL_STRICT"):
+            raise RetrainRejected(gate)
+        return result
+
+    # ---- promote --------------------------------------------------------
+    new_version = version + 1 if incumbent else version
+    with obs_span("continual.promote", version=new_version):
+        archives = [int(v) for v in vinfo.get("archives", [])]
+        if incumbent:
+            archive_base = f"{data_path}.v{version}"
+            _delete_roots(fs, archive_base)
+            _copy_roots(fs, data_path, archive_base)
+            archives.append(version)
+            keep = max(int(knobs.get_int("YTK_CONTINUAL_KEEP")), 0)
+            while len(archives) > keep:
+                _delete_roots(fs, f"{data_path}.v{archives.pop(0)}")
+        n_moved = _promote_roots(fs, shadow_path, data_path)
+        if fi_path and fs.exists(fi_path + SHADOW_SUFFIX):
+            fs.replace(fi_path + SHADOW_SUFFIX, fi_path)
+        _write_version(fs, data_path, {
+            "version": new_version,
+            "promoted_at": time.time(),
+            "mode": mode,
+            "model": model_name,
+            "candidate_loss": gate.candidate_loss,
+            "incumbent_loss": gate.incumbent_loss,
+            "band": band,
+            "archives": archives,
+        })
+    obs_inc("continual.promoted")
+    obs_event(
+        "continual.promoted",
+        model=model_name,
+        version=new_version,
+        files=n_moved,
+        candidate_loss=gate.candidate_loss,
+        incumbent_loss=gate.incumbent_loss,
+        wall_s=round(time.time() - t0, 3),
+    )
+    log.info(
+        "retrain PROMOTED v%d -> v%d (%d files, held-out %s vs %s) in %.1fs",
+        version, new_version, n_moved,
+        f"{gate.candidate_loss:.6f}" if gate.candidate_loss is not None else "n/a",
+        f"{gate.incumbent_loss:.6f}" if gate.incumbent_loss is not None else "n/a",
+        time.time() - t0,
+    )
+    return RetrainResult(
+        promoted=True, version=new_version, gate=gate,
+        model_path=data_path, shadow_path=shadow_path, mode=mode,
+        trained=trained,
+    )
+
+
+def rollback(
+    model_name: str, cfg: dict, fs: Optional[FileSystem] = None
+) -> RetrainResult:
+    """Disk-level undo of the newest promotion: restore the latest
+    `<data_path>.v<N>` archive over the live path (atomic per-file
+    replaces) and stamp the version sidecar — the serving watcher picks
+    the restored incumbent up like any promotion. Complements the
+    in-memory `ModelRegistry.rollback()` hook, which undoes a bad swap
+    without touching disk."""
+    family = _family(model_name)
+    fs = fs or create_filesystem(str(cfg.get("fs_scheme", "local")))
+    params = (
+        GBDTParams.from_config(cfg) if family == "gbdt"
+        else CommonParams.from_config(cfg)
+    )
+    data_path = params.model.data_path
+    vinfo = read_version(fs, data_path)
+    archives = [int(v) for v in vinfo.get("archives", [])]
+    if not archives:
+        raise FileNotFoundError(
+            f"no archived versions next to {data_path} — nothing to roll "
+            "back to (archives are written at promotion time)"
+        )
+    target = archives.pop()
+    archive_base = f"{data_path}.v{target}"
+    with obs_span("continual.rollback", version=target):
+        n = _restore_roots(fs, archive_base, data_path)
+        _write_version(fs, data_path, {
+            "version": target,
+            "promoted_at": time.time(),
+            "mode": str(vinfo.get("mode", "warm")),
+            "model": model_name,
+            "rolled_back_from": int(vinfo.get("version", target + 1)),
+            "archives": archives,
+        })
+    obs_inc("continual.rollbacks")
+    obs_event(
+        "continual.rollback", model=model_name,
+        from_version=int(vinfo.get("version", target + 1)), to_version=target,
+    )
+    log.warning(
+        "retrain ROLLBACK: restored v%d over %s (%d files)",
+        target, data_path, n,
+    )
+    return RetrainResult(
+        promoted=False, version=target, model_path=data_path,
+        mode=str(vinfo.get("mode", "warm")), rolled_back=True,
+    )

@@ -1,0 +1,165 @@
+"""Promotion gates for the continuous-training driver.
+
+A retrained candidate only replaces the serving incumbent when it proves
+itself twice (docs/continual.md):
+
+  health gate   no r8 sentinel fired during candidate training (NaN loss,
+                divergence, rotten ingest, empty/NaN trees — the
+                `health.*` counter deltas over the run), and the
+                candidate's held-out loss is finite.
+  metric gate   candidate held-out loss <= incumbent held-out loss
+                within the configured band (`continual.band`, knob
+                `YTK_CONTINUAL_BAND`; 0 = must be no worse), both
+                measured NOW on the same held-out files — never stale
+                training-time numbers.
+
+A reject keeps the incumbent serving and records a `continual.rejected`
+obs event naming every failed gate.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..obs import snapshot as obs_snapshot, span as obs_span
+from ..predict.base import parse_feature_kvs
+
+log = logging.getLogger("ytklearn_tpu.continual")
+
+
+def health_counters() -> Dict[str, float]:
+    """The top-level `health.<kind>` counters (the r8 sentinel totals) —
+    the same root-counter definition bench.py and the regression gate
+    use (obs/health.py::root_health_counters)."""
+    from ..obs.health import root_health_counters
+
+    return dict(root_health_counters(obs_snapshot()["counters"]))
+
+
+def health_delta(before: Dict[str, float]) -> Dict[str, float]:
+    """Sentinel hits since `before` (a health_counters() snapshot)."""
+    after = health_counters()
+    out = {}
+    for k, v in after.items():
+        d = v - before.get(k, 0.0)
+        if d > 0:
+            out[k] = d
+    return out
+
+
+def holdout_loss(
+    predictor, paths: Sequence[str], max_error_tol: int = 100
+) -> Tuple[float, int]:
+    """Weighted average loss of `predictor` over labeled held-out files
+    (weight###label###features rows, the training text format). Row walks
+    are host numpy; the loss activates in ONE batched call. Returns
+    (avg_loss, n_rows); (nan, 0) when no labeled rows were found."""
+    delim = predictor.params.data.delim
+    fs = predictor.fs
+    fmaps: List[dict] = []
+    weights: List[float] = []
+    labels: List[List[float]] = []
+    errors = 0
+    for path in sorted(fs.recur_get_paths(list(paths))):
+        with fs.open(path) as f:
+            for line in f:
+                line = line.rstrip("\n")
+                if not line.strip():
+                    continue
+                try:
+                    xsplits = line.split(delim.x_delim)
+                    weight = float(xsplits[0])
+                    label = [
+                        float(v) for v in xsplits[1].split(delim.y_delim)
+                    ]
+                    fmap = parse_feature_kvs(xsplits[2], delim)
+                except (IndexError, ValueError) as e:
+                    errors += 1
+                    if errors > max_error_tol:
+                        raise ValueError(
+                            f"held-out file {path}: more than "
+                            f"{max_error_tol} unparseable rows: {e}"
+                        ) from e
+                    continue
+                fmaps.append(fmap)
+                weights.append(weight)
+                labels.append(label)
+    if not fmaps:
+        return float("nan"), 0
+    with obs_span("continual.holdout_eval", rows=len(fmaps)):
+        scores = np.asarray(predictor.batch_scores(fmaps), np.float64)
+        k = scores.shape[1] if scores.ndim > 1 else 1
+        if k > 1:
+            lab = np.zeros((len(labels), k), np.float64)
+            for i, li in enumerate(labels):
+                if len(li) == k:
+                    lab[i] = li
+                else:  # single class index -> one-hot
+                    lab[i, int(li[0])] = 1.0
+        else:
+            lab = np.asarray([li[0] for li in labels], np.float64)
+        w = np.asarray(weights, np.float64)
+        per = np.asarray(predictor.loss.loss(scores, lab), np.float64).reshape(-1)
+        loss = float(np.sum(w * per) / max(np.sum(w), 1e-12))
+    return loss, len(fmaps)
+
+
+@dataclass
+class GateReport:
+    """Outcome of the promotion gates for one retrain candidate."""
+
+    passed: bool
+    reasons: List[str] = field(default_factory=list)
+    candidate_loss: Optional[float] = None
+    incumbent_loss: Optional[float] = None
+    band: float = 0.0
+    holdout_rows: int = 0
+    health: Dict[str, float] = field(default_factory=dict)
+
+
+def evaluate_gates(
+    candidate_loss: Optional[float],
+    incumbent_loss: Optional[float],
+    band: float,
+    health_hits: Dict[str, float],
+    holdout_rows: int = 0,
+) -> GateReport:
+    """Combine the health + metric gates into one report. `None` losses
+    mean "not measurable" (no held-out data / no incumbent): the metric
+    gate then passes vacuously — the health gate always applies."""
+    reasons: List[str] = []
+    if health_hits:
+        hits = ", ".join(f"{k}={v:g}" for k, v in sorted(health_hits.items()))
+        reasons.append(f"health sentinels fired during training: {hits}")
+    if candidate_loss is not None and not math.isfinite(candidate_loss):
+        reasons.append(
+            f"candidate held-out loss is non-finite ({candidate_loss!r})"
+        )
+    elif candidate_loss is not None and incumbent_loss is not None:
+        if math.isfinite(incumbent_loss):
+            limit = incumbent_loss + band * abs(incumbent_loss)
+            if candidate_loss > limit:
+                reasons.append(
+                    f"candidate held-out loss {candidate_loss:.6f} outside "
+                    f"the band vs incumbent {incumbent_loss:.6f} "
+                    f"(limit {limit:.6f}, band {band:g})"
+                )
+        else:
+            log.warning(
+                "incumbent held-out loss is non-finite (%r); metric gate "
+                "passes on the candidate's finiteness alone", incumbent_loss,
+            )
+    return GateReport(
+        passed=not reasons,
+        reasons=reasons,
+        candidate_loss=candidate_loss,
+        incumbent_loss=incumbent_loss,
+        band=band,
+        holdout_rows=holdout_rows,
+        health=dict(health_hits),
+    )

@@ -429,21 +429,21 @@ class GBDTTrainer:
         # bundles BEFORE the matrix reaches HBM. Bundles are capped at the
         # padded bin width B, so the histogram shape never grows; the
         # engine's range tables + tree unbundling keep splits (and every
-        # dumped model) in original feature space. Gated off multi-process
-        # (the plan would need a cross-process conflict merge) and under
-        # continue_train (score replay re-derives slots from original
-        # feature values).
+        # dumped model) in original feature space. Warm starts
+        # (continue_train) stay bundled: the incumbent's score replay runs
+        # on a transient PRE-bundle matrix (original feature space), so
+        # re-bundling is exact — see _init_device_scores. Only the
+        # multi-process case downgrades (the plan would need a cross-
+        # process conflict merge), and it does so loudly: an operator who
+        # asked for EFB must see the fallback in logs AND obs.
         plan = None
-        if self.efb and p.model.continue_train:
-            log.info(
-                "EFB disabled: continue_train score replay needs the "
-                "unbundled bin matrix"
-            )
-        elif self.efb and jax.process_count() > 1:
-            log.info(
+        if self.efb and jax.process_count() > 1:
+            log.warning(
                 "EFB disabled: multi-process runs would need a cross-"
                 "process conflict merge; training unbundled"
             )
+            obs_inc("gbdt.efb.downgrade")
+            obs_event("gbdt.efb.downgrade", reason="multi_process")
         elif self.efb:
             budget = knobs.get_int("YTK_EFB_CONFLICT")
             with obs_span("gbdt.efb.plan", F=F):
@@ -467,26 +467,46 @@ class GBDTTrainer:
         # bin 0 + masked off, so they can never split)
         D = 1 if self.mesh is None else int(self.mesh.devices.size)
         F_prog = -(-F_cols // D) * D
+        # warm-start + EFB: the incumbent's trees split on ORIGINAL feature
+        # ids, so the score replay needs the pre-bundle matrix; keep it as
+        # a transient (n_pad, F) row matrix that _init_device_scores frees
+        # right after the replay
+        keep_replay = plan is not None and p.model.continue_train
+        self._replay_bins = None
         if use_dev_bin:
             n_rows = train.X.shape[0]
             n_pad = -(-n_rows // BM_DEFAULT) * BM_DEFAULT
             Xp = jnp.pad(X_t_dev, ((0, 0), (0, n_pad - n_rows)))
-            bins_t = bin_matrix_device(Xp, bins)
-            if plan is not None:
-                bins_t = bundle_bin_matrix_t(bins_t, plan)
+            bins_t_raw = bin_matrix_device(Xp, bins)
+            bins_t = (
+                bundle_bin_matrix_t(bins_t_raw, plan)
+                if plan is not None
+                else bins_t_raw
+            )
             if B <= 256:
                 bins_t = bins_t.astype(jnp.uint8)  # quarter the routing/DMA
-            del X_t_dev, Xp
+            if keep_replay:
+                self._replay_bins = [jnp.transpose(bins_t_raw)]
+            del X_t_dev, Xp, bins_t_raw
         else:
-            bins_np = bin_matrix(train.X, bins)
+            bins_np_raw = bin_matrix(train.X, bins)
             if plan is not None:
                 bins_np = np.asarray(
-                    bundle_bin_matrix_t(bins_np.T, plan)
+                    bundle_bin_matrix_t(bins_np_raw.T, plan)
                 ).T
+            else:
+                bins_np = bins_np_raw
             bins_t_np, n_pad = pad_inputs(
                 bins_np, n_pad=self._shard_target(bins_np), F_pad=F_prog
             )
             bins_t = self._put_cols(bins_t_np)
+            if keep_replay:
+                self._replay_bins = [
+                    self._put(
+                        _pad0(bins_np_raw.astype(np.int32), n_pad)
+                    )
+                ]
+            del bins_np_raw
         y = self._put(_pad0(train.y, n_pad))
         weight = self._put(_pad0(train.weight, n_pad))
         real_mask = self._put(np.arange(n_pad) < train.X.shape[0])
@@ -504,24 +524,38 @@ class GBDTTrainer:
                 Xt_t = jnp.pad(
                     jnp.transpose(jax.device_put(test.X)), ((0, 0), (0, nt_pad - nt))
                 )
-                bt_dev = bin_matrix_device(Xt_t, bins)
-                if plan is not None:
-                    bt_dev = bundle_bin_matrix_t(bt_dev, plan)
+                bt_raw = bin_matrix_device(Xt_t, bins)
+                bt_dev = (
+                    bundle_bin_matrix_t(bt_raw, plan)
+                    if plan is not None
+                    else bt_raw
+                )
                 if B <= 256:
                     bt_dev = bt_dev.astype(jnp.uint8)
                 aux_bins = (bt_dev,)
-                del Xt_t, bt_dev
+                if keep_replay:
+                    self._replay_bins.append(jnp.transpose(bt_raw))
+                del Xt_t, bt_dev, bt_raw
             else:
-                bins_test_np = bin_matrix(test.X, bins)
+                bins_test_raw = bin_matrix(test.X, bins)
                 if plan is not None:
                     bins_test_np = np.asarray(
-                        bundle_bin_matrix_t(bins_test_np.T, plan)
+                        bundle_bin_matrix_t(bins_test_raw.T, plan)
                     ).T
+                else:
+                    bins_test_np = bins_test_raw
                 bt_np, nt_pad = pad_inputs(
                     bins_test_np, n_pad=self._shard_target(bins_test_np),
                     F_pad=F_prog,
                 )
                 aux_bins = (self._put_cols(bt_np),)
+                if keep_replay:
+                    self._replay_bins.append(
+                        self._put(
+                            _pad0(bins_test_raw.astype(np.int32), nt_pad)
+                        )
+                    )
+                del bins_test_raw
             y_t = self._put(_pad0(test.y, nt_pad))
             w_t = self._put(_pad0(test.weight, nt_pad))
             nt_score = nt_pad * jax.process_count()
@@ -548,8 +582,19 @@ class GBDTTrainer:
             else:
                 scores_t = jnp.full((dd.nt_score,), float(base_np), jnp.float32)
         if model.trees:
-            bins_dev = jnp.transpose(dd.bins_t)
-            bins_test_dev = jnp.transpose(dd.aux_bins[0]) if dd.aux_bins else None
+            # EFB warm start: the incumbent's trees split on original
+            # feature ids, so replay walks the transient PRE-bundle matrix
+            # (_prep_device_inputs keeps it only for this loop); bundled
+            # training then proceeds on dd.bins_t as usual
+            replay = getattr(self, "_replay_bins", None)
+            if replay is not None:
+                bins_dev = replay[0]
+                bins_test_dev = replay[1] if len(replay) > 1 else None
+            else:
+                bins_dev = jnp.transpose(dd.bins_t)
+                bins_test_dev = (
+                    jnp.transpose(dd.aux_bins[0]) if dd.aux_bins else None
+                )
             for i, t in enumerate(model.trees):
                 add = self._tree_scores_from_raw(t, dd.bins, bins_dev)
                 scores = scores.at[:, i % K].add(add) if K > 1 else scores + add
@@ -559,6 +604,7 @@ class GBDTTrainer:
                         scores_t.at[:, i % K].add(add_t) if K > 1 else scores_t + add_t
                     )
             del bins_dev, bins_test_dev
+        self._replay_bins = None  # free the pre-bundle replay matrices
         return scores, scores_t
 
     def _make_tree_bufs(self, M: int):
@@ -1589,6 +1635,7 @@ class GBDTTrainer:
 
     _missing_fill: Optional[np.ndarray] = None
     _efb_plan = None  # BundlePlan when EFB merged columns this run
+    _replay_bins = None  # transient pre-bundle matrices for warm-start replay
 
     def _tree_scores_from_raw(self, tree: Tree, bins: FeatureBins, bins_dev):
         """Score a converted (value-space) tree against the bin matrix by
@@ -1646,13 +1693,16 @@ class GBDTTrainer:
         if jax.process_index() != 0:
             return  # rank0-only dump (reference: GBDTOptimizer.java:434-437)
         p = self.params
-        with self.fs.open(p.model.data_path, "w") as f:
+        # atomic write-then-replace: the serving registry hot-reloads this
+        # file on a fingerprint watch, so a reader must never see a
+        # half-written ensemble
+        with self.fs.atomic_open(p.model.data_path) as f:
             f.write(model.dumps(with_stats=True))
         if p.model.feature_importance_path:
             # reference format: header + name\tsum_split_count\tsum_gain
             # (dataflow/GBDTDataFlow.dumpFeatureImportance:397-415)
             imp = model.feature_importance()
-            with self.fs.open(p.model.feature_importance_path, "w") as f:
+            with self.fs.atomic_open(p.model.feature_importance_path) as f:
                 f.write("feature_name\tsum_split_count\tsum_gain\n")
                 for name, (cnt, gain) in imp.items():
                     f.write(f"{name}\t{cnt}\t{gain}\n")

@@ -8,9 +8,22 @@ factory fs/FileSystemFactory.java:54).
 
 from __future__ import annotations
 
+import contextlib
 import os
 import glob as _glob
 from typing import IO, Iterable, Iterator, List, Sequence
+
+
+#: marker in the names atomic_open writes before the replace; loaders and
+#: the serving fingerprint watcher skip such paths so a tmp file left by a
+#: crashed writer is never parsed as model content
+TMP_MARKER = ".tmp-"
+
+
+def is_tmp_path(path: str) -> bool:
+    """True for in-flight atomic_open temp files (skip when walking a
+    model tree)."""
+    return TMP_MARKER in path.rsplit("/", 1)[-1]
 
 
 class FileSystem:
@@ -27,6 +40,34 @@ class FileSystem:
 
     def delete(self, path: str) -> None:
         raise NotImplementedError
+
+    def replace(self, src: str, dst: str) -> None:
+        """Move `src` over `dst`, replacing it. Atomic on the local
+        filesystem (os.replace); remote schemes degrade to delete+move,
+        which is the strongest those stores offer."""
+        raise NotImplementedError
+
+    @contextlib.contextmanager
+    def atomic_open(self, path: str, mode: str = "w"):
+        """Write-then-replace: the file at `path` either keeps its old
+        content or carries the complete new content — a reader (e.g. the
+        serving registry's fingerprint watcher) can never observe a
+        half-written file. On error the temp file is removed and `path`
+        is untouched."""
+        tmp = f"{path}{TMP_MARKER}{os.getpid()}"
+        f = self.open(tmp, mode)
+        try:
+            yield f
+        except BaseException:
+            f.close()
+            try:
+                self.delete(tmp)
+            # ytklint: allow(broad-except) reason=cleanup of the temp file is best-effort; the original exception below is the failure that matters
+            except Exception:
+                pass
+            raise
+        f.close()
+        self.replace(tmp, path)
 
     def recur_get_paths(self, paths: Sequence[str]) -> List[str]:
         """Expand directories (recursively) and globs into a flat file list
@@ -83,6 +124,12 @@ class LocalFileSystem(FileSystem):
         elif os.path.exists(path):
             os.remove(path)
 
+    def replace(self, src: str, dst: str) -> None:
+        dst = self._strip(dst)
+        parent = os.path.dirname(os.path.abspath(dst))
+        os.makedirs(parent, exist_ok=True)
+        os.replace(self._strip(src), dst)
+
     def recur_get_paths(self, paths: Sequence[str]) -> List[str]:
         out: List[str] = []
         for p in paths:
@@ -132,6 +179,14 @@ class FsspecFileSystem(FileSystem):
     def delete(self, path: str) -> None:
         if self.fs.exists(path):
             self.fs.rm(path, recursive=True)
+
+    def replace(self, src: str, dst: str) -> None:
+        # remote object stores have no atomic rename; delete+move is the
+        # closest equivalent (readers racing this see missing-then-new,
+        # never a half-written file, because `src` was written in full)
+        if self.fs.exists(dst):
+            self.fs.rm(dst)
+        self.fs.mv(src, dst)
 
     def recur_get_paths(self, paths: Sequence[str]) -> List[str]:
         out: List[str] = []

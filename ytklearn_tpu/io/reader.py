@@ -513,7 +513,7 @@ class DataIngest:
             return
         inv = {i: n for n, i in fmap.items()}
         path = self.params.model.data_path + "_feature_transform_stat"
-        with self.fs.open(path, "w") as f:
+        with self.fs.atomic_open(path) as f:
             for i, node in sorted(nodes.items()):
                 f.write(f"{inv[i]}###{node}\n")
 

@@ -139,11 +139,13 @@ class ConvexModel:
         rank: int = 0,
         n_parts: int = 1,
     ) -> None:
-        """Per-feature text lines; subclasses supply model_line()."""
+        """Per-feature text lines; subclasses supply model_line(). Both
+        files land via atomic write-then-replace so the serving registry's
+        fingerprint watcher never parses a half-written dump."""
         p = self.params.model
         start, end = self._feature_slice(rank, n_parts)
         model_path, dict_path = self._part_paths(rank)
-        with fs.open(model_path, "w") as mf, fs.open(dict_path, "w") as df:
+        with fs.atomic_open(model_path) as mf, fs.atomic_open(dict_path) as df:
             for name, i in feature_map.items():
                 if not (start <= i < end):
                     continue
@@ -163,11 +165,15 @@ class ConvexModel:
     def load_model(
         self, fs: FileSystem, feature_map: Dict[str, int]
     ) -> Optional[np.ndarray]:
+        from ..io.fs import is_tmp_path
+
         p = self.params.model
         if not fs.exists(p.data_path):
             return None
         w = self.init_weights()
         for path in sorted(fs.recur_get_paths([p.data_path])):
+            if is_tmp_path(path):
+                continue  # in-flight atomic_open temp from a writer
             with fs.open(path) as f:
                 for line in f:
                     line = line.strip()

@@ -182,7 +182,7 @@ class GBSTModel(ConvexModel):
         w = np.asarray(w)
         path = f"{p.data_path}/tree-{tree_id:05d}/model-{rank:05d}"
         dict_path = f"{p.data_path}_dict/dict-{rank:05d}"
-        with fs.open(path, "w") as mf, fs.open(dict_path, "w") as df:
+        with fs.atomic_open(path) as mf, fs.atomic_open(dict_path) as df:
             mf.write(f"k:{K}\n")
             if self.scalar_leaves:
                 # bare leaf-value line right after the header
@@ -211,8 +211,12 @@ class GBSTModel(ConvexModel):
         tree_dir = f"{p.data_path}/tree-{tree_id:05d}"
         if not fs.exists(tree_dir):
             return None
+        from ..io.fs import is_tmp_path
+
         w = np.zeros((self.dim,), np.float32)
         for path in sorted(fs.recur_get_paths([tree_dir])):
+            if is_tmp_path(path):
+                continue  # in-flight atomic_open temp from a writer
             with fs.open(path) as f:
                 expect_leaves = False
                 for line in f:
@@ -244,7 +248,7 @@ class GBSTModel(ConvexModel):
     def dump_tree_info(self, fs: FileSystem, finished: int, base_score: float) -> None:
         """reference: GBMLRDataFlow.dumpModelInfo."""
         p = self.params
-        with fs.open(f"{p.model.data_path}/tree-info", "w") as f:
+        with fs.atomic_open(f"{p.model.data_path}/tree-info") as f:
             f.write(f"K:{self.K}\n")
             f.write(f"tree_num:{p.tree_num}\n")
             f.write(f"finished_tree_num:{finished}\n")

@@ -129,7 +129,7 @@ class LinearModel(ConvexModel):
         d = p.delim
         model_path = f"{p.data_path}/model-{rank:05d}"
         dict_path = f"{p.data_path}_dict/dict-{rank:05d}"
-        with fs.open(model_path, "w") as mf, fs.open(dict_path, "w") as df:
+        with fs.atomic_open(model_path) as mf, fs.atomic_open(dict_path) as df:
             for name, i in feature_map.items():
                 if not (start <= i < end):
                     continue
@@ -148,11 +148,15 @@ class LinearModel(ConvexModel):
         """Read `name,weight[,precision]` lines from all model parts
         (reference: LinearModelDataFlow.loadModel:68-110). Unknown names are
         skipped; absent file -> None (fresh model)."""
+        from ..io.fs import is_tmp_path
+
         p = self.params.model
         if not fs.exists(p.data_path):
             return None
         w = np.zeros((self.dim,), np.float32)
         for path in sorted(fs.recur_get_paths([p.data_path])):
+            if is_tmp_path(path):
+                continue  # in-flight atomic_open temp from a writer
             with fs.open(path) as f:
                 for line in f:
                     line = line.strip()

@@ -236,19 +236,22 @@ def check_tree(site: str, n_nodes: int, gains: Sequence[float], **args) -> bool:
     return ok
 
 
+def root_health_counters(counters) -> dict:
+    """The ROOT `health.<kind>` counters (the per-site
+    `health.<kind>.<site>` breakdown would double-count every hit). THE
+    definition of "a sentinel fired" — bench.py, the regression gate's
+    old-artifact fallback, and the continual promotion gate all consume
+    it and must agree, or one gate compares skewed numbers."""
+    return {
+        k: v
+        for k, v in counters.items()
+        if k.startswith("health.") and k.count(".") == 1
+    }
+
+
 def total_sentinel_hits(counters) -> int:
-    """Sum the ROOT `health.<kind>` counters (the per-site
-    `health.<kind>.<site>` breakdown would double-count every hit). The
-    single definition bench.py and the regression gate's old-artifact
-    fallback both use — they must agree or the gate compares skewed
-    numbers."""
-    return int(
-        sum(
-            v
-            for k, v in counters.items()
-            if k.startswith("health.") and k.count(".") == 1
-        )
-    )
+    """Sum of the root sentinel counters (see root_health_counters)."""
+    return int(sum(root_health_counters(counters).values()))
 
 
 # ---------------------------------------------------------------------------

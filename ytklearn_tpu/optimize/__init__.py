@@ -1,1 +1,2 @@
+from .ftrl import FTRLConfig, FTRLState, ftrl_init, ftrl_pass, make_ftrl_step
 from .lbfgs import LBFGSConfig, LBFGSResult, inv_hessian_vp, minimize_lbfgs

@@ -1,0 +1,142 @@
+"""FTRL-Proximal — the streaming-update optimizer for the convex families.
+
+McMahan et al., "Ad Click Prediction: a View from the Trenches" (KDD 2013;
+PAPERS.md) — per-coordinate adaptive learning rates with L1-induced
+sparsity, the standard online-update rule for linear/logistic models under
+a stream of fresh examples. No reference counterpart: the reference
+retrains offline with L-BFGS and restarts; here FTRL closes the
+train->serve freshness loop (docs/continual.md) as the cheap alternative
+to a full warm-start refit when only a delta of new rows arrived.
+
+Per coordinate i, after observing gradient g_i:
+
+    n_i  += g_i^2
+    sigma = (sqrt(n_i) - sqrt(n_i - g_i^2)) / alpha
+    z_i  += g_i - sigma * w_i
+    w_i   = 0                                      if |z_i| <= l1_i
+          = -(z_i - sign(z_i) l1_i) / ((beta + sqrt(n_i))/alpha + l2_i)
+
+The whole minibatch step (gradient + accumulator update + closed-form
+weight solve) is ONE jitted program; state stays on device across the
+stream, so a pass over k minibatches costs k dispatches and zero host
+round-trips. The update is deterministic for a fixed data order —
+`tests/test_continual.py` pins bit-stable convergence.
+
+Warm start: `ftrl_init(w0, ...)` inverts the closed form so the first
+weight solve reproduces the checkpoint exactly (z0 chosen with n0 = 0),
+making "resume from the incumbent model" the natural entry state.
+
+L1/L2 arrive as per-coordinate VECTORS (models' `reg_vectors` surface) so
+the bias slot rides unregularized exactly like the L-BFGS path.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..obs import inc as obs_inc, span as obs_span
+
+
+@dataclass(frozen=True)
+class FTRLConfig:
+    """Hyperparameters (the paper's alpha/beta/lambda1/lambda2); l1/l2
+    here are scalars broadcast through the model's reg_vectors."""
+
+    alpha: float = 0.1
+    beta: float = 1.0
+    l1: float = 0.0
+    l2: float = 0.0
+
+
+class FTRLState(NamedTuple):
+    w: jnp.ndarray  # current weights (the closed-form solve of z, n)
+    z: jnp.ndarray  # accumulated (gradient - sigma*w) sums
+    n: jnp.ndarray  # accumulated squared gradients
+
+
+def ftrl_init(
+    w0: jnp.ndarray,
+    cfg: FTRLConfig,
+    l1_vec: Optional[jnp.ndarray] = None,
+    l2_vec: Optional[jnp.ndarray] = None,
+) -> FTRLState:
+    """State whose closed-form solve reproduces `w0` bit-for-bit at n=0:
+    z0 = -w0 * (beta/alpha + l2) - sign(w0) * l1 (zero weights get z0=0,
+    which the solve keeps at exactly 0 whenever l1 >= 0)."""
+    w0 = jnp.asarray(w0, jnp.float32)
+    l1v = jnp.zeros_like(w0) if l1_vec is None else jnp.asarray(l1_vec)
+    l2v = jnp.zeros_like(w0) if l2_vec is None else jnp.asarray(l2_vec)
+    denom = cfg.beta / cfg.alpha + l2v
+    z0 = jnp.where(w0 != 0.0, -w0 * denom - jnp.sign(w0) * l1v, 0.0)
+    return FTRLState(w=w0, z=z0, n=jnp.zeros_like(w0))
+
+
+def make_ftrl_step(
+    grad_fn: Callable, cfg: FTRLConfig
+) -> Callable:
+    """Build the jitted minibatch update.
+
+    grad_fn(w, *batch) -> gradient of the AVERAGE (weight-normalized) loss
+    over the minibatch. Returned step(state, l1_vec, l2_vec, *batch) ->
+    FTRLState; reg vectors ride as arguments so one compiled program
+    serves every (l1, l2) setting.
+    """
+    alpha, beta = cfg.alpha, cfg.beta
+
+    def step(state: FTRLState, l1_vec, l2_vec, *batch) -> FTRLState:
+        g = grad_fn(state.w, *batch)
+        n_new = state.n + g * g
+        sigma = (jnp.sqrt(n_new) - jnp.sqrt(state.n)) / alpha
+        z_new = state.z + g - sigma * state.w
+        denom = (beta + jnp.sqrt(n_new)) / alpha + l2_vec
+        w_new = jnp.where(
+            jnp.abs(z_new) <= l1_vec,
+            0.0,
+            -(z_new - jnp.sign(z_new) * l1_vec) / denom,
+        )
+        return FTRLState(w=w_new, z=z_new, n=n_new)
+
+    return jax.jit(step)
+
+
+def ftrl_pass(
+    model,
+    w0,
+    batch: tuple,
+    cfg: FTRLConfig,
+    batch_rows: int = 8192,
+    n_real: Optional[int] = None,
+) -> FTRLState:
+    """One deterministic pass of FTRL minibatch updates over `batch` (the
+    model's make_batch arrays, host or device, rows first).
+
+    Rows are consumed in order, `batch_rows` at a time — for a freshness
+    delta the stream IS the new data, so one pass is the intended use
+    (call repeatedly for more epochs). `n_real` clips trailing padding
+    rows; partially-weighted rows are handled by the weight column
+    (grad_fn normalizes by the minibatch weight sum).
+    """
+    l1_vec, l2_vec = model.reg_vectors(cfg.l1, cfg.l2)
+
+    def grad_fn(w, *b):
+        *rest, weight = b
+        total = jnp.maximum(jnp.sum(weight), 1e-12)
+        return jax.grad(model.pure_loss)(w, *b) / total
+
+    step = make_ftrl_step(grad_fn, cfg)
+    state = ftrl_init(w0, cfg, l1_vec, l2_vec)
+    n = int(batch[0].shape[0]) if n_real is None else int(n_real)
+    n_steps = 0
+    with obs_span("continual.ftrl_pass", rows=n, batch_rows=batch_rows):
+        for lo in range(0, n, batch_rows):
+            hi = min(lo + batch_rows, n)
+            mb = tuple(jnp.asarray(a[lo:hi]) for a in batch)
+            state = step(state, l1_vec, l2_vec, *mb)
+            n_steps += 1
+    obs_inc("continual.ftrl_steps", n_steps)
+    obs_inc("continual.ftrl_rows", n)
+    return state

@@ -78,8 +78,12 @@ class ContinuousPredictor(OnlinePredictor):
 
     def _model_lines(self, path: str):
         """Yield delim-split nonempty lines from every model part file."""
+        from ..io.fs import is_tmp_path
+
         d = self.params.model.delim
         for part in sorted(self.fs.recur_get_paths([path])):
+            if is_tmp_path(part):
+                continue  # in-flight atomic_open temp from a writer
             with self.fs.open(part) as f:
                 for line in f:
                     line = line.strip()

@@ -15,7 +15,7 @@ import numpy as np
 
 from ..config.params import CommonParams, GBDTParams
 from ..gbdt.tree import GBDTModel
-from ..io.fs import FileSystem
+from ..io.fs import FileSystem, is_tmp_path
 from ..losses import create_loss
 from .base import OnlinePredictor
 from .continuous import ContinuousPredictor
@@ -178,6 +178,8 @@ class GBSTPredictor(ContinuousPredictor):
             tmap: Dict[str, np.ndarray] = {}
             leaf_vals = None
             for part in sorted(self.fs.recur_get_paths([tree_dir])):
+                if is_tmp_path(part):
+                    continue  # in-flight atomic_open temp from a writer
                 with self.fs.open(part) as f:
                     expect_leaves = False
                     for line in f:

@@ -14,10 +14,21 @@ picks it up without dropping traffic:
      the registry lock) and records a `serve.reload` obs event.
 
 A request therefore always sees exactly one model version: whichever entry
-reference its batch resolved. A half-written dump just fingerprints
-differently again on the next poll and reloads once it settles; a dump
-that fails to parse keeps the old entry serving and fires
-`serve.reload_failed`.
+reference its batch resolved. Trainer dumps are atomic (write tmp +
+os.replace, io/fs.py atomic_open) so the watcher can never observe a
+half-written file; in-flight `*.tmp-*` names are excluded from the
+fingerprint, and a multi-file dump caught mid-promotion is caught at the
+set level too — the fingerprint is re-taken after the warm load and a
+mismatch defers the swap (`serve.reload_deferred`) until the file set
+settles. A dump that fails to parse keeps the old entry serving and
+fires `serve.reload_failed`.
+
+Continuous-training handshake (docs/continual.md): the `ytklearn-tpu
+retrain` driver promotes a validated candidate over the served path and
+the watcher picks it up like any other dump. `pin(name)` freezes a model
+at its current in-memory version (the watcher skips it);
+`rollback(name)` swaps back to the previously served entry and pins, so
+a bad promotion is undone in one call without touching disk.
 """
 
 from __future__ import annotations
@@ -30,6 +41,7 @@ import time
 from typing import Dict, Optional
 
 from ..config import knobs
+from ..io.fs import is_tmp_path
 from ..obs import event as obs_event, gauge as obs_gauge, inc as obs_inc
 from ..predict import create_predictor
 from .scorer import CompiledScorer
@@ -37,11 +49,19 @@ from .scorer import CompiledScorer
 log = logging.getLogger("ytklearn_tpu.serve")
 
 
+class NoPreviousVersion(KeyError):
+    """rollback() on a loaded model that has never been reloaded: the
+    model exists but there is no previous entry to return to — a state
+    error (HTTP 409), not an unknown name (404)."""
+
+
 def _sidecar_paths(predictor) -> list:
     """Every file the loaded model was parsed from (data_path tree +
-    transform-stat / field-dict / tree-info sidecars where configured)."""
+    transform-stat / field-dict / tree-info sidecars where configured),
+    plus the continual driver's version sidecar so a re-promotion with
+    identical weights still fingerprints as a change."""
     p = predictor.params
-    paths = [p.model.data_path]
+    paths = [p.model.data_path, p.model.data_path + ".version.json"]
     feature = getattr(p, "feature", None)
     if feature is not None and feature.transform.switch_on:
         paths.append(p.model.data_path + "_feature_transform_stat")
@@ -62,6 +82,8 @@ def model_fingerprint(predictor) -> str:
         except FileNotFoundError:
             continue
         for f in sorted(files):
+            if is_tmp_path(f):
+                continue  # in-flight atomic write; settles by next poll
             try:
                 st = os.stat(f)
                 h.update(f"{f}:{st.st_size}:{st.st_mtime_ns};".encode())
@@ -97,6 +119,8 @@ class ModelRegistry:
             watch_interval_s = knobs.get_float("YTK_SERVE_WATCH_S")
         self.watch_interval_s = watch_interval_s
         self._entries: Dict[str, _Entry] = {}
+        self._prev: Dict[str, _Entry] = {}  # last swapped-out entry per name
+        self._pinned: set = set()  # names the watcher must not reload
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._watcher: Optional[threading.Thread] = None
@@ -111,6 +135,7 @@ class ModelRegistry:
             prev = self._entries.get(name)
             if prev is not None:
                 entry.version = prev.version + 1
+                self._prev[name] = prev  # rollback target
             self._entries[name] = entry
         obs_gauge("serve.models", len(self._entries))
         log.info(
@@ -142,12 +167,67 @@ class ModelRegistry:
         with self._lock:
             return len(self._entries)
 
+    # -- version pinning / rollback ---------------------------------------
+
+    def pinned(self, name: str) -> bool:
+        with self._lock:
+            return name in self._pinned
+
+    def pin(self, name: str) -> None:
+        """Freeze `name` at its current in-memory version: the watcher (and
+        explicit maybe_reload calls) skip it until unpin()."""
+        self.get(name)  # KeyError for unknown names
+        with self._lock:
+            self._pinned.add(name)
+        obs_event("serve.pin", model=name)
+        log.info("serve: pinned %r (hot reload disabled)", name)
+
+    def unpin(self, name: str) -> None:
+        self.get(name)  # KeyError for unknown names (a typo must not 200)
+        with self._lock:
+            self._pinned.discard(name)
+        obs_event("serve.unpin", model=name)
+        log.info("serve: unpinned %r (hot reload re-enabled)", name)
+
+    def rollback(self, name: str) -> _Entry:
+        """Swap `name` back to the previously served entry (the one the
+        last load/reload replaced) and PIN it, so the watcher doesn't
+        immediately re-promote the bad on-disk model. The undo button for
+        a bad continual promotion; raises KeyError for an unknown name
+        and NoPreviousVersion for a known model with nothing to return
+        to (the server maps them to 404 vs 409)."""
+        with self._lock:
+            entry = self._entries.get(name)
+            prev = self._prev.get(name)
+            if entry is None:
+                raise KeyError(f"no model named {name!r} is loaded")
+            if prev is None:
+                raise NoPreviousVersion(
+                    f"model {name!r} has no previous version to roll back to"
+                )
+            self._entries[name] = prev
+            self._prev[name] = entry  # rollback is itself undoable
+            self._pinned.add(name)
+        obs_inc("serve.rollback")
+        obs_event(
+            "serve.rollback", model=name,
+            from_version=entry.version, to_version=prev.version,
+        )
+        log.warning(
+            "serve: rolled back %r v%d -> v%d and pinned (unpin to resume "
+            "hot reload)", name, entry.version, prev.version,
+        )
+        return prev
+
     # -- hot reload -------------------------------------------------------
 
     def maybe_reload(self, name: str) -> bool:
         """Reload `name` if its files changed. Warm first, swap after —
-        traffic never sees a cold or half-swapped scorer. True = swapped."""
+        traffic never sees a cold or half-swapped scorer. True = swapped.
+        Pinned names never reload (version-pinning hook)."""
         entry = self.get(name)
+        if self.pinned(name):
+            return False
         fp = model_fingerprint(entry.predictor)
         if fp == entry.fingerprint:
             return False
@@ -168,7 +248,30 @@ class ModelRegistry:
             log.warning("serve: reload of %r failed, keeping v%d: %s",
                         name, entry.version, e)
             return False
+        if model_fingerprint(fresh.predictor) != fp:
+            # the file SET changed while _build was parsing it (a multi-file
+            # promotion caught mid-move): individual files are whole (atomic
+            # replaces) but the loaded predictor may blend versions — don't
+            # serve it; the next poll reloads once the set settles
+            obs_inc("serve.reload_deferred")
+            log.info(
+                "serve: reload of %r deferred — model files changed during "
+                "the warm load; keeping v%d until the set settles",
+                name, entry.version,
+            )
+            return False
         with self._lock:
+            if name in self._pinned:
+                # pinned (or rolled back, which pins) DURING the warm load:
+                # the operator's freeze wins over the in-flight build
+                obs_inc("serve.reload_deferred")
+                log.info(
+                    "serve: reload of %r discarded — pinned during the "
+                    "warm load; keeping v%d",
+                    name, self._entries[name].version,
+                )
+                return False
+            self._prev[name] = self._entries[name]  # rollback target
             self._entries[name] = fresh  # the atomic swap
         obs_inc("serve.reload")
         obs_event(

@@ -31,6 +31,7 @@ concept and not supported here.
 
 from __future__ import annotations
 
+import contextlib
 import math
 import threading
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -68,6 +69,27 @@ class _LadderRetraceSentinel(obs_health.RetraceSentinel):
     @staticmethod
     def _compiles() -> float:
         return obs_health.RetraceSentinel._compiles() - _warmup_compile_credit
+
+
+@contextlib.contextmanager
+def compile_credit():
+    """Attribute every XLA compile inside the block to a known-good cause
+    so armed scorers don't count them as steady-state serving retraces.
+    Used by scorer warmups, and by the continual retrain driver when a
+    candidate trains IN-PROCESS next to live serving (docs/continual.md):
+    training compiles are expected, a /predict-path compile still is not."""
+    global _warmup_compile_credit, _warmups_in_progress
+    before = obs_health.RetraceSentinel._compiles()
+    _warmups_in_progress += 1
+    try:
+        yield
+    finally:
+        # credit BEFORE dropping the in-progress flag, so once the flag
+        # clears the subtraction is already settled
+        _warmup_compile_credit += (
+            obs_health.RetraceSentinel._compiles() - before
+        )
+        _warmups_in_progress -= 1
 
 
 def parse_ladder(spec: Optional[str] = None) -> Tuple[int, ...]:
@@ -122,23 +144,13 @@ class CompiledScorer:
         import jax
         import jax.numpy as jnp
 
-        global _warmup_compile_credit, _warmups_in_progress
-        before = obs_health.RetraceSentinel._compiles()
-        _warmups_in_progress += 1
-        try:
+        with compile_credit():
             with obs_span("serve.warmup", rungs=len(self.ladder)):
                 for rung in self.ladder:
                     X = np.full((rung, self.dim), self._fill, np.float64)
                     s, p = self._jit(jnp.asarray(X))
                     jax.device_get((s, p))  # block: compile+execute now
                     obs_inc("serve.scorer.warmup_rungs")
-        finally:
-            # credit BEFORE dropping the in-progress flag, so once the flag
-            # clears the subtraction is already settled
-            _warmup_compile_credit += (
-                obs_health.RetraceSentinel._compiles() - before
-            )
-            _warmups_in_progress -= 1
         self._sentinel.arm()
         self._warm = True
 

@@ -17,6 +17,10 @@ Endpoints (JSON in/out):
   GET /readyz      200 only when models are loaded+warm and not draining
   GET /metrics     obs registry snapshot + request latency p50/p99/p999,
                    queue depth, per-model versions
+  POST /admin/rollback {"model": name}  swap back to the previously served
+                   version and pin (undo a bad continual promotion)
+  POST /admin/pin  {"model": name}  freeze the served version (watcher
+                   skips it); /admin/unpin re-enables hot reload
 
 SIGTERM (install_signal_handlers) flips /readyz to 503, stops intake,
 drains queued requests to completion, then stops the listener — the
@@ -44,7 +48,7 @@ from .batcher import (
     OverloadError,
     ServeClosed,
 )
-from .registry import ModelRegistry
+from .registry import ModelRegistry, NoPreviousVersion
 
 log = logging.getLogger("ytklearn_tpu.serve")
 
@@ -179,6 +183,7 @@ class ServeApp:
                 n: {
                     "version": self.registry.get(n).version,
                     "ladder": list(self.registry.get(n).scorer.ladder),
+                    "pinned": self.registry.pinned(n),
                 }
                 for n in self.registry.names()
             },
@@ -205,6 +210,40 @@ class ServeApp:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _admin(self, action: str) -> None:
+                """Registry version control: rollback / pin / unpin by
+                model name (default: the first loaded model)."""
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(n) or b"{}")
+                    if not isinstance(req, dict):
+                        raise ValueError(
+                            "request body must be a JSON object"
+                        )
+                    names = app.registry.names()
+                    if not names:
+                        raise KeyError("no models loaded")
+                    name = req.get("model") or names[0]
+                    if action == "rollback":
+                        entry = app.registry.rollback(name)
+                        self._json(200, {"model": name, "action": action,
+                                         "version": entry.version,
+                                         "pinned": True})
+                    else:
+                        getattr(app.registry, action)(name)
+                        self._json(200, {"model": name, "action": action,
+                                         "pinned": app.registry.pinned(name)})
+                except NoPreviousVersion as e:
+                    # the model exists; there is just nothing to roll back
+                    # to — not an unknown-name 404
+                    self._json(409, {"error": str(e.args[0]),
+                                     "type": "no_previous_version"})
+                except KeyError as e:
+                    self._json(404, {"error": str(e.args[0]),
+                                     "type": "unknown_model"})
+                except (ValueError, json.JSONDecodeError) as e:
+                    self._json(400, {"error": str(e), "type": "bad_request"})
+
             def do_GET(self):  # noqa: N802 — stdlib handler API
                 if self.path == "/healthz":
                     self._json(200, app.health_payload())
@@ -220,6 +259,10 @@ class ServeApp:
                     self._json(404, {"error": f"unknown path {self.path}"})
 
             def do_POST(self):  # noqa: N802
+                if self.path in ("/admin/rollback", "/admin/pin",
+                                 "/admin/unpin"):
+                    self._admin(self.path.rsplit("/", 1)[1])
+                    return
                 if self.path != "/predict":
                     self._json(404, {"error": f"unknown path {self.path}"})
                     return
