@@ -1,0 +1,710 @@
+"""Resilience layer (ytklearn_tpu/resilience, docs/fault_tolerance.md).
+
+Covers the three pillars on synthetic data (no /root/reference needed):
+deterministic chaos injection (spec grammar, counter-based reproducible
+draws, obs evidence), retry/backoff (transient-vs-fatal classification,
+deterministic backoff, giveup budget, the fs.read_lines / atomic_open /
+serve-reload integrations), and the preemption contract — the
+acceptance pins: SIGTERM mid-GBDT-train -> emergency checkpoint -> exit
+143 -> `--resume auto` -> final dump BIT-IDENTICAL to the uninterrupted
+run; a kill -9 stand-in (os._exit in a subprocess) resumes bit-identically
+off the periodic dump_freq checkpoints alone; transient ingest faults at
+the default retry budget cause zero run failures. Plus the satellites:
+heartbeat retrain lock with dead-owner auto-reclaim, the flight
+recorder's SIGINT hook, and the continual gate's CompiledScorer eval.
+"""
+
+import hashlib
+import json
+import math
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from ytklearn_tpu import obs
+from ytklearn_tpu.resilience import (
+    ChaosError,
+    ChaosOSError,
+    Preempted,
+    PreemptionGuard,
+    RetryPolicy,
+    chaos_point,
+    is_transient,
+    parse_chaos_spec,
+    reset_chaos,
+    retry_call,
+    site_draw,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos(monkeypatch):
+    """Every test starts disarmed with fresh counters and fast backoff."""
+    monkeypatch.delenv("YTK_CHAOS", raising=False)
+    monkeypatch.setenv("YTK_RETRY_BASE_S", "0.001")
+    monkeypatch.setenv("YTK_RETRY_MAX_S", "0.01")
+    reset_chaos()
+    yield
+    reset_chaos()
+
+
+def _write_rows(path, n, seed, nonlinear=False):
+    r = np.random.RandomState(seed)
+    w = np.random.RandomState(7).randn(8)
+    with open(path, "w") as f:
+        for _ in range(n):
+            x = r.randn(8)
+            s = x @ w
+            if nonlinear:
+                s += 1.5 * x[0] * x[1] - abs(x[2])
+            y = int(r.rand() < 1.0 / (1.0 + math.exp(-s)))
+            f.write("1###%d###%s\n" % (
+                y, ",".join(f"c{i}:{x[i]:.5f}" for i in range(8))))
+
+
+@pytest.fixture(scope="module")
+def data_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("resilience_data")
+    _write_rows(d / "lin.train", 300, 1)
+    _write_rows(d / "lin.holdout", 150, 2)
+    _write_rows(d / "g.train", 350, 3, nonlinear=True)
+    return d
+
+
+def _gbdt_conf(data_dir, tmp_path, model, dump_freq=2, rounds=5):
+    p = tmp_path / f"{model}.conf"
+    p.write_text(
+        f'data {{ train {{ data_path = "{data_dir / "g.train"}" }} '
+        "max_feature_dim = 8 }\n"
+        f'model {{ data_path = "{tmp_path / model}" '
+        f"dump_freq = {dump_freq} }}\n"
+        'loss { loss_function = "sigmoid" }\n'
+        f"optimization {{ round_num = {rounds}, max_depth = 3, "
+        "learning_rate = 0.3 }\n"
+    )
+    return str(p)
+
+
+def _sha(path) -> str:
+    return hashlib.sha256(open(path, "rb").read()).hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# chaos: spec grammar + deterministic counter-based draws
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_spec_grammar():
+    rules = parse_chaos_spec("io.read:oserror:0.5:7,gbdt.sync:sigterm:1:0")
+    assert [r.site for r in rules] == ["io.read", "gbdt.sync"]
+    assert rules[0].kind == "oserror" and rules[0].rate == 0.5
+    with pytest.raises(ValueError, match="kind"):
+        parse_chaos_spec("io.read:explode:0.5:7")
+    with pytest.raises(ValueError, match="rate"):
+        parse_chaos_spec("io.read:oserror:1.5:7")
+    with pytest.raises(ValueError, match="site:kind:rate:seed"):
+        parse_chaos_spec("io.read:oserror:0.5")
+
+
+def test_chaos_draws_are_deterministic_and_counter_based(monkeypatch):
+    # the same (seed, site, n) always draws the same value
+    assert site_draw(7, "io.read", 3) == site_draw(7, "io.read", 3)
+    assert site_draw(7, "io.read", 3) != site_draw(7, "io.read", 4)
+    assert site_draw(8, "io.read", 3) != site_draw(7, "io.read", 3)
+
+    monkeypatch.setenv("YTK_CHAOS", "io.read:oserror:0.5:7")
+
+    def schedule(n):
+        out = []
+        for _ in range(n):
+            try:
+                chaos_point("io.read")
+                out.append(False)
+            except ChaosOSError:
+                out.append(True)
+        return out
+
+    first = schedule(32)
+    assert any(first) and not all(first)  # rate 0.5 actually samples
+    reset_chaos()
+    assert schedule(32) == first  # counter reset -> identical schedule
+    # and the schedule is exactly the precomputable draw sequence
+    assert first == [site_draw(7, "io.read", n + 1) < 0.5 for n in range(32)]
+
+
+def test_chaos_malformed_spec_raises_every_call(monkeypatch):
+    """A typo'd spec must fail EVERY chaos_point, not just the first —
+    a swallowed one-time ValueError would silently disarm the drill."""
+    monkeypatch.setenv("YTK_CHAOS", "io.read:explode:1:0")
+    with pytest.raises(ValueError, match="kind"):
+        chaos_point("io.read")
+    with pytest.raises(ValueError, match="kind"):
+        chaos_point("io.read")
+
+
+def test_chaos_prefix_match_and_evidence(monkeypatch):
+    monkeypatch.setenv("YTK_CHAOS", "io.*:oserror:1:0")
+    obs.configure(enabled=True)
+    try:
+        obs.reset()
+        with pytest.raises(ChaosOSError):
+            chaos_point("io.dump")
+        chaos_point("serve.load")  # no match -> no injection
+        snap = obs.snapshot()["counters"]
+        assert snap.get("chaos.injected") == 1
+        assert snap.get("chaos.injected.io.dump") == 1
+        assert any(e.get("name") == "chaos.inject" for e in obs.REGISTRY.events)
+    finally:
+        obs.configure(enabled=False)
+        obs.reset()
+
+
+# ---------------------------------------------------------------------------
+# retry: classification, backoff, budget
+# ---------------------------------------------------------------------------
+
+
+def test_retry_recovers_transient(monkeypatch):
+    sleeps = []
+    monkeypatch.setattr(time, "sleep", lambda s: sleeps.append(s))
+    obs.configure(enabled=True)
+    try:
+        obs.reset()
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise OSError("transient")
+            return "ok"
+
+        assert retry_call(flaky, site="t.flaky") == "ok"
+        assert len(calls) == 3 and len(sleeps) == 2
+        assert all(s > 0 for s in sleeps)
+        snap = obs.snapshot()["counters"]
+        assert snap["io.retry.attempts"] == 2
+        assert snap["io.retry.t.flaky"] == 2
+        assert snap["io.retry.recovered"] == 1
+    finally:
+        obs.configure(enabled=False)
+        obs.reset()
+
+
+def test_retry_backoff_is_deterministic():
+    p = RetryPolicy(max_attempts=5, base_s=0.1, max_s=10.0)
+    d = [p.delay_s(k, "x") for k in range(1, 5)]
+    assert d == [p.delay_s(k, "x") for k in range(1, 5)]  # reproducible
+    raw = [0.1, 0.2, 0.4, 0.8]
+    for got, r in zip(d, raw):
+        assert 0.5 * r <= got < r  # jittered into [0.5, 1.0)x
+    assert p.delay_s(40, "x") < 10.0  # capped
+
+
+def test_retry_fatal_not_retried(monkeypatch):
+    sleeps = []
+    monkeypatch.setattr(time, "sleep", lambda s: sleeps.append(s))
+    for exc in (FileNotFoundError("gone"), ValueError("bug"),
+                ChaosError("fatal-injected")):
+        calls = []
+
+        def fail(_e=exc):
+            calls.append(1)
+            raise _e
+
+        with pytest.raises(type(exc)):
+            retry_call(fail, site="t.fatal")
+        assert len(calls) == 1 and sleeps == []
+    assert not is_transient(ChaosError("x"))
+    assert is_transient(ChaosOSError(5, "x"))
+
+
+def test_retry_gives_up_at_budget(monkeypatch):
+    monkeypatch.setattr(time, "sleep", lambda s: None)
+    monkeypatch.setenv("YTK_RETRY_MAX", "3")
+    obs.configure(enabled=True)
+    try:
+        obs.reset()
+        calls = []
+
+        def always():
+            calls.append(1)
+            raise OSError("still down")
+
+        with pytest.raises(OSError, match="still down"):
+            retry_call(always, site="t.giveup")
+        assert len(calls) == 3
+        assert obs.snapshot()["counters"]["io.retry.giveup"] == 1
+    finally:
+        obs.configure(enabled=False)
+        obs.reset()
+
+
+# ---------------------------------------------------------------------------
+# fs integration: read_lines + atomic_open under injected faults
+# ---------------------------------------------------------------------------
+
+
+def test_retry_lines_resumes_mid_stream_without_double_yield(monkeypatch):
+    """A transient failure MID-read reopens the source and skips the
+    already-yielded count — streaming (O(1) memory), no duplicate lines."""
+    from ytklearn_tpu.resilience import retry_lines
+
+    monkeypatch.setattr(time, "sleep", lambda s: None)
+    opens = []
+
+    class FlakyFile:
+        def __init__(self, fail_after):
+            self.lines = ["a\n", "b\n", "c\n", "d\n"]
+            self.fail_after = fail_after
+            self.i = 0
+
+        def __iter__(self):
+            return self
+
+        def __next__(self):
+            if self.i == self.fail_after:
+                raise OSError("mid-read reset")
+            if self.i >= len(self.lines):
+                raise StopIteration
+            self.i += 1
+            return self.lines[self.i - 1]
+
+        def close(self):
+            pass
+
+    def open_fn():
+        opens.append(1)
+        # first open dies after 2 lines; the reopen streams clean
+        return FlakyFile(fail_after=2 if len(opens) == 1 else None)
+
+    assert list(retry_lines(open_fn, site="t.stream")) == [
+        "a\n", "b\n", "c\n", "d\n"
+    ]
+    assert len(opens) == 2
+
+
+def test_read_lines_retries_chaos_faults(tmp_path, monkeypatch):
+    from ytklearn_tpu.io.fs import LocalFileSystem
+
+    p = tmp_path / "x.txt"
+    p.write_text("a\nb\nc")
+    monkeypatch.setenv("YTK_CHAOS", "io.read:oserror:0.5:3")
+    fs = LocalFileSystem()
+    assert list(fs.read_lines([str(p)])) == ["a", "b", "c"]
+
+
+def test_atomic_open_commit_retries(tmp_path, monkeypatch):
+    from ytklearn_tpu.io.fs import LocalFileSystem
+
+    # pick a seed that injects on the first commit draw and passes later
+    seed = next(s for s in range(1000)
+                if site_draw(s, "io.dump", 1) < 0.6
+                and site_draw(s, "io.dump", 2) >= 0.6)
+    monkeypatch.setenv("YTK_CHAOS", f"io.dump:oserror:0.6:{seed}")
+    fs = LocalFileSystem()
+    target = tmp_path / "m.txt"
+    with fs.atomic_open(str(target)) as f:
+        f.write("payload")
+    assert target.read_text() == "payload"
+    assert not [n for n in os.listdir(tmp_path) if ".tmp-" in n]
+
+
+def test_transient_ingest_faults_zero_run_failures(data_dir, tmp_path,
+                                                   monkeypatch, capsys):
+    """The acceptance contract: injected transient IO faults at the
+    default retry budget cause ZERO run failures."""
+    from ytklearn_tpu.cli import train_main
+
+    conf = tmp_path / "lin.conf"
+    conf.write_text(
+        f'data {{ train {{ data_path = "{data_dir / "lin.train"}" }} }}\n'
+        f'model {{ data_path = "{tmp_path / "m"}" }}\n'
+        'loss { loss_function = "sigmoid" }\n'
+        'optimization { line_search { lbfgs { convergence '
+        '{ max_iter = 3 } } } }\n'
+    )
+    monkeypatch.setenv("YTK_CHAOS", "io.read:oserror:0.5:3")
+    obs.configure(enabled=True)
+    try:
+        obs.reset()
+        rc = train_main(["linear", str(conf), "--devices", "1"])
+        snap = obs.snapshot()["counters"]
+    finally:
+        from ytklearn_tpu.obs import recorder
+
+        recorder.uninstall()  # trainer auto-installed under enabled obs
+        obs.configure(enabled=False)
+        obs.reset()
+    capsys.readouterr()
+    assert rc == 0
+    assert (tmp_path / "m").exists()
+    assert snap.get("chaos.injected.io.read", 0) >= 1
+    # every injected fault was absorbed by a retry, and left evidence
+    assert snap["io.retry.io.read"] == snap["chaos.injected.io.read"]
+
+
+# ---------------------------------------------------------------------------
+# preemption guard + recorder SIGINT hook
+# ---------------------------------------------------------------------------
+
+
+def test_guard_defers_sigterm_and_raises_at_boundary():
+    g = PreemptionGuard().install()
+    try:
+        assert not g.triggered
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert g.triggered and g.signum == signal.SIGTERM
+        with pytest.raises(Preempted) as ei:
+            g.preempt("/tmp/ckpt")
+        assert ei.value.exit_code == 143
+        assert "/tmp/ckpt" in str(ei.value)
+    finally:
+        g.uninstall()
+    # handlers restored: a guard-free SIGTERM must use the default again
+    assert signal.getsignal(signal.SIGTERM) != g._handler
+
+
+def test_guard_second_sigint_escalates():
+    from ytklearn_tpu.obs import recorder
+
+    recorder.uninstall()  # escalation must land on the python default
+    g = PreemptionGuard().install()
+    try:
+        os.kill(os.getpid(), signal.SIGINT)
+        assert g.triggered and g.signum == signal.SIGINT
+        with pytest.raises(KeyboardInterrupt):
+            os.kill(os.getpid(), signal.SIGINT)
+    finally:
+        g.uninstall()
+
+
+def test_guard_inert_off_main_thread():
+    import threading
+
+    out = {}
+
+    def run():
+        g = PreemptionGuard().install()
+        out["installed"] = g.installed
+        g.uninstall()
+
+    t = threading.Thread(target=run)
+    t.start()
+    t.join()
+    assert out["installed"] is False
+
+
+def test_recorder_sigint_dumps_flight(tmp_path):
+    from ytklearn_tpu.obs import recorder
+
+    recorder.uninstall()  # fresh hooks (a prior test may have consumed them)
+    obs.configure(enabled=True)
+    recorder.install(flight_dir=str(tmp_path))
+    try:
+        with pytest.raises(KeyboardInterrupt):
+            os.kill(os.getpid(), signal.SIGINT)
+        dumps = [n for n in os.listdir(tmp_path) if n.startswith("flight_")]
+        assert len(dumps) == 1
+        doc = json.load(open(tmp_path / dumps[0]))
+        assert doc["flight"]["reason"] == "sigint"
+    finally:
+        recorder.uninstall()
+        obs.configure(enabled=False)
+        obs.reset()
+
+
+# ---------------------------------------------------------------------------
+# the kill→resume contract (GBDT, acceptance)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def gbdt_baseline(data_dir, tmp_path_factory):
+    """Uninterrupted run: the bit-identity oracle."""
+    from ytklearn_tpu.cli import train_main
+
+    d = tmp_path_factory.mktemp("gbdt_base")
+    conf = _gbdt_conf(data_dir, d, "base")
+    rc = train_main(["gbdt", conf, "--devices", "1"])
+    assert rc == 0
+    return _sha(d / "base")
+
+
+def test_gbdt_sigterm_resume_bit_identical(data_dir, tmp_path, monkeypatch,
+                                           gbdt_baseline, capsys):
+    """SIGTERM mid-train -> emergency checkpoint + exit 143; --resume auto
+    completes; the final dump is bit-identical to the uninterrupted run
+    (round-indexed RNG keys + exact score replay)."""
+    from ytklearn_tpu.cli import train_main
+
+    conf = _gbdt_conf(data_dir, tmp_path, "pre")
+    monkeypatch.setenv("YTK_CHAOS", "gbdt.sync:sigterm:1:0")
+    rc = train_main(["gbdt", conf, "--devices", "1"])
+    assert rc == 143
+    assert (tmp_path / "pre").exists()  # emergency checkpoint
+    mid = _sha(tmp_path / "pre")
+    assert mid != gbdt_baseline  # partial, not the final model
+
+    monkeypatch.delenv("YTK_CHAOS")
+    reset_chaos()
+    rc = train_main(["gbdt", conf, "--resume", "auto", "--devices", "1"])
+    capsys.readouterr()
+    assert rc == 0
+    assert _sha(tmp_path / "pre") == gbdt_baseline
+
+
+def test_gbdt_kill9_resume_bit_identical(data_dir, tmp_path, gbdt_baseline,
+                                         capsys):
+    """kill -9 stand-in: chaos kind=kill os._exit(137)s a SUBPROCESS with
+    no handlers/atexit — only the periodic dump_freq checkpoint survives;
+    --resume auto still reproduces the uninterrupted run bit-identically."""
+    from ytklearn_tpu.cli import train_main
+
+    conf = _gbdt_conf(data_dir, tmp_path, "k9", dump_freq=1)
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "YTK_CHAOS": "gbdt.sync:kill:1:0",
+        "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+    })
+    proc = subprocess.run(
+        [sys.executable, "-m", "ytklearn_tpu.cli", "train", "gbdt", conf,
+         "--devices", "1"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=600,
+    )
+    assert proc.returncode == 137, proc.stderr[-2000:]
+    assert (tmp_path / "k9").exists()  # dump_freq checkpoint survived
+
+    rc = train_main(["gbdt", conf, "--resume", "auto", "--devices", "1"])
+    capsys.readouterr()
+    assert rc == 0
+    assert _sha(tmp_path / "k9") == gbdt_baseline
+
+
+def test_convex_preempt_and_resume(data_dir, tmp_path, monkeypatch, capsys):
+    """Convex families: SIGTERM defers to the iteration callback, which
+    dumps the L-BFGS checkpoint weights and exits 143; --resume auto
+    warm-starts from them and completes."""
+    from ytklearn_tpu.cli import train_main
+
+    conf = tmp_path / "lin.conf"
+    conf.write_text(
+        f'data {{ train {{ data_path = "{data_dir / "lin.train"}" }} }}\n'
+        f'model {{ data_path = "{tmp_path / "m"}" dump_freq = 1 }}\n'
+        'loss { loss_function = "sigmoid" }\n'
+        'optimization { line_search { lbfgs { convergence '
+        '{ max_iter = 6 } } } }\n'
+    )
+    # the dump_freq=1 checkpoint commit is an io.dump chaos site: inject
+    # a sigterm there -> the NEXT callback hits the preemption boundary
+    monkeypatch.setenv("YTK_CHAOS", "io.dump:sigterm:1:0")
+    rc = train_main(["linear", str(conf), "--devices", "1"])
+    assert rc == 143
+    assert (tmp_path / "m").exists()
+
+    monkeypatch.delenv("YTK_CHAOS")
+    reset_chaos()
+    rc = train_main(["linear", str(conf), "--resume", "auto", "--devices", "1"])
+    capsys.readouterr()
+    assert rc == 0
+
+
+# ---------------------------------------------------------------------------
+# retrain lock: metadata + dead-owner auto-reclaim + heartbeat
+# ---------------------------------------------------------------------------
+
+
+def test_retrain_lock_metadata_and_contention(tmp_path):
+    from ytklearn_tpu.continual import RetrainLock
+    from ytklearn_tpu.io.fs import LocalFileSystem
+
+    fs = LocalFileSystem()
+    path = str(tmp_path / "m.retrain.lock")
+    lock = RetrainLock(fs, path).acquire()
+    try:
+        owner = json.load(open(path))
+        assert owner["pid"] == os.getpid()
+        assert owner["host"] and owner["heartbeat_at"] > 0
+        # a live same-host owner is NOT reclaimable
+        with pytest.raises(RuntimeError, match="auto-reclaims"):
+            RetrainLock(fs, path).acquire()
+    finally:
+        lock.release()
+    assert not os.path.exists(path)
+
+
+def test_retrain_lock_reclaims_dead_owner(tmp_path):
+    from ytklearn_tpu.continual import RetrainLock
+    from ytklearn_tpu.io.fs import LocalFileSystem
+
+    import socket
+
+    # a real dead pid: spawn-and-reap, so os.kill(pid, 0) raises
+    proc = subprocess.Popen([sys.executable, "-c", "pass"])
+    proc.wait()
+    fs = LocalFileSystem()
+    path = str(tmp_path / "m.retrain.lock")
+    with open(path, "w") as f:
+        json.dump({"pid": proc.pid, "host": socket.gethostname(),
+                   "started_at": time.time(), "heartbeat_at": time.time()}, f)
+    lock = RetrainLock(fs, path).acquire()  # reclaims, does not raise
+    lock.release()
+
+
+def test_retrain_lock_reclaims_stale_heartbeat_and_legacy(tmp_path):
+    from ytklearn_tpu.continual import RetrainLock
+    from ytklearn_tpu.io.fs import LocalFileSystem
+
+    fs = LocalFileSystem()
+    path = str(tmp_path / "m.retrain.lock")
+    # remote-host owner whose heartbeat went stale past the TTL
+    with open(path, "w") as f:
+        json.dump({"pid": 1, "host": "some-dead-tpu-vm",
+                   "started_at": 0.0, "heartbeat_at": time.time() - 5.0}, f)
+    lock = RetrainLock(fs, path, ttl_s=1.0).acquire()
+    lock.release()
+    # pre-metadata legacy lock content is reclaimable too
+    with open(path, "w") as f:
+        f.write("pid=123 t=456\n")
+    lock = RetrainLock(fs, path, ttl_s=1.0).acquire()
+    lock.release()
+
+
+def test_retrain_lock_release_respects_foreign_owner(tmp_path):
+    """A lock legitimately reclaimed by a peer (this process stalled past
+    the TTL) must not be clobbered by our release/heartbeat."""
+    import socket
+
+    from ytklearn_tpu.continual import RetrainLock
+    from ytklearn_tpu.io.fs import LocalFileSystem
+
+    fs = LocalFileSystem()
+    path = str(tmp_path / "m.retrain.lock")
+    lock = RetrainLock(fs, path).acquire()
+    # a peer reclaims and writes its own record while we are stalled
+    with open(path, "w") as f:
+        json.dump({"pid": os.getpid() + 1, "host": socket.gethostname(),
+                   "started_at": time.time(), "heartbeat_at": time.time()}, f)
+    lock.release()
+    assert os.path.exists(path)  # the peer's lock survives our release
+    assert json.load(open(path))["pid"] == os.getpid() + 1
+
+
+def test_retrain_lock_heartbeat_advances(tmp_path):
+    from ytklearn_tpu.continual import RetrainLock
+    from ytklearn_tpu.io.fs import LocalFileSystem
+
+    fs = LocalFileSystem()
+    path = str(tmp_path / "m.retrain.lock")
+    lock = RetrainLock(fs, path, ttl_s=1.5).acquire()  # beat every 0.5s
+    try:
+        first = json.load(open(path))["heartbeat_at"]
+        deadline = time.time() + 5.0
+        while time.time() < deadline:
+            time.sleep(0.1)
+            if json.load(open(path))["heartbeat_at"] > first:
+                break
+        assert json.load(open(path))["heartbeat_at"] > first
+    finally:
+        lock.release()
+
+
+# ---------------------------------------------------------------------------
+# continual gate eval through CompiledScorer + serve reload retry
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def linear_model(data_dir, tmp_path_factory):
+    from ytklearn_tpu.cli import train_main
+
+    d = tmp_path_factory.mktemp("linmodel")
+    conf = d / "lin.conf"
+    conf.write_text(
+        f'data {{ train {{ data_path = "{data_dir / "lin.train"}" }} }}\n'
+        f'model {{ data_path = "{d / "m"}" }}\n'
+        'loss { loss_function = "sigmoid" }\n'
+        'optimization { line_search { lbfgs { convergence '
+        '{ max_iter = 5 } } } }\n'
+    )
+    rc = train_main(["linear", str(conf), "--devices", "1"])
+    assert rc == 0
+    from ytklearn_tpu.config import hocon
+
+    return hocon.load(str(conf))
+
+
+def test_gate_eval_compiled_matches_host_walk(linear_model, data_dir):
+    from ytklearn_tpu.continual.gates import holdout_loss
+    from ytklearn_tpu.predict import create_predictor
+
+    paths = [str(data_dir / "lin.holdout")]
+    pred = create_predictor("linear", linear_model)
+    loss_c, n_c = holdout_loss(pred, paths, compiled=True)
+    loss_h, n_h = holdout_loss(pred, paths, compiled=False)
+    assert n_c == n_h > 0
+    assert math.isfinite(loss_c)
+    np.testing.assert_allclose(loss_c, loss_h, rtol=1e-9)
+
+
+def test_serve_reload_retries_transient_chaos(linear_model, monkeypatch):
+    from ytklearn_tpu.serve.registry import ModelRegistry
+
+    registry = ModelRegistry(watch_interval_s=0)
+    registry.load("m", "linear", linear_model)
+    assert registry.get("m").version == 1
+
+    # change the fingerprint (version sidecar), then reload under chaos
+    # that injects on the first warm-load attempt and passes the second
+    mpath = linear_model["model"]["data_path"]
+    with open(mpath + ".version.json", "w") as f:
+        json.dump({"version": 2, "archives": []}, f)
+    seed = next(s for s in range(1000)
+                if site_draw(s, "serve.load", 1) < 0.6
+                and site_draw(s, "serve.load", 2) >= 0.6)
+    monkeypatch.setenv("YTK_CHAOS", f"serve.load:oserror:0.6:{seed}")
+    obs.configure(enabled=True)
+    try:
+        obs.reset()
+        assert registry.maybe_reload("m") is True
+        snap = obs.snapshot()["counters"]
+    finally:
+        obs.configure(enabled=False)
+        obs.reset()
+    assert registry.get("m").version == 2
+    assert snap["io.retry.serve.load"] == 1
+    assert snap["chaos.injected.serve.load"] == 1
+
+
+def test_serve_reload_fatal_keeps_old_model(linear_model, monkeypatch,
+                                            tmp_path):
+    """Fatal (kind=error) chaos is NOT retried: the reload fails once and
+    the registry keeps serving the old entry — typed classification at
+    work, with the evidence counters to prove which path ran."""
+    from ytklearn_tpu.serve.registry import ModelRegistry
+
+    registry = ModelRegistry(watch_interval_s=0)
+    registry.load("m", "linear", linear_model)
+    v = registry.get("m").version
+    mpath = linear_model["model"]["data_path"]
+    with open(mpath + ".version.json", "w") as f:
+        json.dump({"version": 99, "archives": []}, f)
+    monkeypatch.setenv("YTK_CHAOS", "serve.load:error:1:0")
+    obs.configure(enabled=True)
+    try:
+        obs.reset()
+        assert registry.maybe_reload("m") is False
+        snap = obs.snapshot()["counters"]
+    finally:
+        obs.configure(enabled=False)
+        obs.reset()
+    assert registry.get("m").version == v  # old model kept serving
+    assert snap.get("serve.reload_failed") == 1
+    assert "io.retry.serve.load" not in snap  # fatal -> no retry

@@ -30,6 +30,7 @@ def test_rule_catalog_is_the_issue_catalog():
         "undeclared-knob",
         "broad-except-swallow",
         "bare-print",
+        "sleep-in-except",
         "serve-lock-discipline",
     }
     for r in RULES.values():
@@ -302,6 +303,69 @@ class Q:
     def reset(self):
         {reset_body}
 """
+
+
+def test_sleep_in_except_fails():
+    src = """
+    import time
+
+    def fetch(path):
+        for _ in range(3):
+            try:
+                return open(path).read()
+            except OSError:
+                time.sleep(1.0)
+    """
+    assert rules_hit(src) == {"sleep-in-except"}
+    # bare `from time import sleep` spelling is the same ad-hoc loop
+    src2 = """
+    from time import sleep
+
+    def fetch(path):
+        try:
+            return open(path).read()
+        except OSError:
+            sleep(0.5)
+    """
+    assert rules_hit(src2) == {"sleep-in-except"}
+
+
+def test_sleep_in_except_passes():
+    # sleeping OUTSIDE a handler (polling) is not a retry loop
+    src = """
+    import time
+
+    def poll(path):
+        while not ready(path):
+            time.sleep(1.0)
+    """
+    assert run(src, select=["sleep-in-except"]) == []
+    # the sanctioned implementation is exempt by path
+    src2 = """
+    import time
+
+    def retry_call(fn):
+        try:
+            return fn()
+        except OSError:
+            time.sleep(0.1)
+    """
+    assert run(src2, path="ytklearn_tpu/resilience/retry.py",
+               select=["sleep-in-except"]) == []
+
+
+def test_sleep_in_except_suppression():
+    src = """
+    import time
+
+    def fetch(path):
+        try:
+            return open(path).read()
+        except OSError:
+            # ytklint: allow(sleep-in-except) reason=test fixture exercising the raw loop
+            time.sleep(1.0)
+    """
+    assert run(src, select=["sleep-in-except"]) == []
 
 
 def test_serve_lock_discipline_fails():

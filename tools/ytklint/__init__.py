@@ -4,7 +4,7 @@ The generic linters the ecosystem ships cannot see this repo's real
 hazards: a hidden host sync inside a jitted hot path, retrace bait in a
 traced closure, an undeclared YTK_* knob, a broad except that swallows a
 failure, a serve-class attribute mutated outside its lock. ytklint is a
-small AST framework (core.py) plus six rules (rules.py) that encode
+small AST framework (core.py) plus seven rules (rules.py) that encode
 exactly those invariants, with an inline suppression syntax:
 
     # ytklint: allow(<rule>[, <rule>]) reason=<non-empty explanation>

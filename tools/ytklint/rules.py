@@ -418,7 +418,40 @@ def bare_print(ctx) -> Iterable[Tuple[int, str]]:
 
 
 # ---------------------------------------------------------------------------
-# Rule 6: serve-lock-discipline
+# Rule 6: sleep-in-except (ad-hoc retry loops)
+# ---------------------------------------------------------------------------
+
+_RETRY_PY = "ytklearn_tpu/resilience/retry.py"
+
+
+@rule(
+    "sleep-in-except",
+    "time.sleep inside an except handler — an ad-hoc retry/backoff loop "
+    "that bypasses ytklearn_tpu.resilience.retry (no typed transient "
+    "classification, no capped backoff, no io.retry.* evidence)",
+    applies=lambda p: not p.endswith(_RETRY_PY),
+)
+def sleep_in_except(ctx) -> Iterable[Tuple[int, str]]:
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        for stmt in node.body:
+            for n in ast.walk(stmt):
+                if not isinstance(n, ast.Call):
+                    continue
+                dotted = _dotted(n.func)
+                if dotted == "time.sleep" or (
+                    isinstance(n.func, ast.Name) and n.func.id == "sleep"
+                ):
+                    yield (n.lineno,
+                           "sleep inside an except handler is an ad-hoc "
+                           "retry loop — route through resilience.retry."
+                           "retry_call (typed classification, capped "
+                           "deterministic backoff, io.retry.* counters)")
+
+
+# ---------------------------------------------------------------------------
+# Rule 7: serve-lock-discipline
 # ---------------------------------------------------------------------------
 
 

@@ -27,6 +27,7 @@ from .io.reader import DataIngest, IngestResult
 from .losses import create_loss
 from .models.gbst import GBSTModel
 from .optimize import LBFGSConfig, minimize_lbfgs
+from .resilience import trainer_guard
 
 log = logging.getLogger("ytklearn_tpu.boost")
 
@@ -67,7 +68,17 @@ class GBSTTrainer:
     def _put_rep(self, arr):
         return jax.device_put(arr)
 
+    _guard = None  # PreemptionGuard while train() runs (resilience/preempt.py)
+
     def train(self, ingest: Optional[IngestResult] = None) -> BoostResult:
+        # preemption-safe: SIGTERM/SIGINT defer to the next tree boundary;
+        # every finished tree is already dumped (tree-%05d + tree-info), so
+        # the boundary just exits via Preempted and `--resume auto`
+        # continues at the last finished tree (docs/fault_tolerance.md)
+        with trainer_guard(self):
+            return self._train_impl(ingest)
+
+    def _train_impl(self, ingest: Optional[IngestResult] = None) -> BoostResult:
         p = self.params
         t0 = time.time()
         if ingest is None:
@@ -155,6 +166,12 @@ class GBSTTrainer:
         compensate = 1.0 / p.instance_sample_rate
 
         for tree in range(finished, tree_num):
+            if self._guard is not None and self._guard.triggered:
+                # trees [0, tree) are on disk (dump_tree + tree-info per
+                # round) — the dump trail IS the checkpoint
+                self._guard.preempt(
+                    p.model.data_path, family=self.variant, trees=tree,
+                )
             # per-tree Bernoulli masks (reference: randomNextSample)
             inst = (rng_inst.rand(ds_train.n) <= p.instance_sample_rate).astype(np.float32)
             inst[ds_train.n_real :] = 0.0

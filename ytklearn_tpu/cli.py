@@ -121,6 +121,13 @@ def train_main(argv: Optional[List[str]] = None) -> int:
                     help="on failure, retry with model.continue_train=true to "
                     "resume from the last checkpoint dump (reference: the "
                     "bin/hadoop_optimizer.sh:53-80 restart loop)")
+    ap.add_argument("--resume", default="never", choices=("never", "auto"),
+                    help="auto: when a complete checkpoint already exists at "
+                    "model.data_path, re-enter training from it "
+                    "(model.continue_train=true) — the relaunch half of the "
+                    "preemption contract: a SIGTERM'd run dumps an emergency "
+                    "checkpoint at its next round/iteration boundary and "
+                    "exits 143 (docs/fault_tolerance.md)")
     ap.add_argument("--coordinator", default="",
                     help="host:port of the jax.distributed coordinator — the "
                     "CommMaster equivalent; use with --num-processes/"
@@ -171,6 +178,20 @@ def train_main(argv: Optional[List[str]] = None) -> int:
     name = args.model_name
 
     log = logging.getLogger("ytklearn_tpu.cli")
+    if args.resume == "auto":
+        # atomic dumps (fs.atomic_open) mean model.data_path either holds
+        # the newest COMPLETE checkpoint or nothing — no torn-file triage
+        from .io.fs import create_filesystem as _mkfs
+
+        _fs = _mkfs(str(cfg.get("fs_scheme", "local")))
+        _mpath = hocon.get_path(cfg, "model.data_path")
+        if _mpath and _fs.exists(str(_mpath)):
+            cfg = hocon.set_path(cfg, "model.continue_train", True)
+            log.info("--resume auto: checkpoint found at %s; resuming", _mpath)
+        else:
+            log.info(
+                "--resume auto: no checkpoint at %s; cold start", _mpath
+            )
     restarts = max(args.max_restarts, 0)
     import jax as _jax
 
@@ -185,11 +206,21 @@ def train_main(argv: Optional[List[str]] = None) -> int:
             "last checkpoint"
         )
         restarts = 0
+    from .resilience import Preempted
+
     for attempt in range(restarts + 1):
         try:
             rc = _train_once(name, cfg, mesh, hook)
             _flush_trace(args.trace_out)
             return rc
+        except Preempted as e:
+            # not a failure: the emergency checkpoint is on disk and the
+            # restart loop must NOT eat the grace period re-entering
+            # training — exit with the signal's conventional status so
+            # the scheduler relaunches (with --resume auto) instead
+            log.warning("%s; exiting %d", e, e.exit_code)
+            _flush_trace(args.trace_out)
+            return e.exit_code
         except KeyboardInterrupt:
             raise
         except Exception:
@@ -404,6 +435,8 @@ def retrain_main(argv: Optional[List[str]] = None) -> int:
 
     mesh = _make_mesh(args.devices)
     hook = _load_hook(args.transform, args.transform_script)
+    from .resilience import Preempted
+
     try:
         res = retrain(
             args.model_name, cfg, mesh=mesh,
@@ -411,6 +444,12 @@ def retrain_main(argv: Optional[List[str]] = None) -> int:
             extra_rounds=args.extra_rounds if args.extra_rounds >= 0 else None,
             transform_hook=hook,
         )
+    except Preempted as e:
+        # candidate training was preempted; the incumbent keeps serving,
+        # the lock is released, and the next cron tick simply retrains
+        logging.getLogger("ytklearn_tpu.cli").warning("%s; exiting %d", e, e.exit_code)
+        _flush_trace(args.trace_out)
+        return e.exit_code
     except RetrainRejected as e:
         # YTK_CONTINUAL_STRICT=1: a rejection is a hard failure for the
         # surrounding pipeline, but still a clean JSON record on stdout

@@ -153,7 +153,33 @@ _knob("YTK_FLIGHT_DIR", "str", "flight_dumps",
       "flight-dump directory (default: `flight_dumps/`, which is "
       "gitignored — a crash dump must never end up committed)")
 
+# -- resilience (docs/fault_tolerance.md) -----------------------------------
+_knob("YTK_CHAOS", "str", None,
+      "deterministic fault injection spec `site:kind:rate:seed[,...]` "
+      "(kinds: oserror|error|sigterm|kill); counter-based draws make "
+      "every injected fault reproducible — see "
+      "[fault_tolerance.md](fault_tolerance.md)")
+_knob("YTK_RETRY_MAX", "int", 4,
+      "attempt budget per `resilience.retry` site (1 = no retries)")
+_knob("YTK_RETRY_BASE_S", "float", 0.05,
+      "first-retry backoff in seconds (doubles per attempt, "
+      "deterministically jittered into [0.5, 1.0)x)")
+_knob("YTK_RETRY_MAX_S", "float", 2.0,
+      "backoff ceiling in seconds for the retry exponential")
+_knob("YTK_PREEMPT", "bool", True,
+      "preemption guard in trainers: SIGTERM/SIGINT deferred to the next "
+      "round/iteration boundary, emergency checkpoint, exit 128+signum "
+      "(`--resume auto` re-enters training); `0` keeps raw signal "
+      "semantics")
+_knob("YTK_RETRAIN_LOCK_TTL_S", "float", 900.0,
+      "retrain lockfile heartbeat staleness (seconds) after which a new "
+      "retrain auto-reclaims the lock; same-host dead owners are "
+      "reclaimed immediately")
+
 # -- continual training -----------------------------------------------------
+_knob("YTK_GATE_COMPILED", "bool", True,
+      "route the continual gate's held-out eval through CompiledScorer "
+      "(batched jit scoring); `0` falls back to the host row walk")
 _knob("YTK_CONTINUAL_BAND", "float", 0.0,
       "relative held-out-loss tolerance for retrain promotion: a candidate "
       "passes the metric gate when loss <= incumbent * (1 + band); 0 = "

@@ -25,6 +25,7 @@ CLI: `python -m ytklearn_tpu.cli retrain <model> <conf>` /
 from __future__ import annotations
 
 from .driver import (  # noqa: F401
+    RetrainLock,
     RetrainRejected,
     RetrainResult,
     read_version,
@@ -42,6 +43,7 @@ from .online import ftrl_update_convex  # noqa: F401
 
 __all__ = [
     "GateReport",
+    "RetrainLock",
     "RetrainRejected",
     "RetrainResult",
     "evaluate_gates",

@@ -35,6 +35,8 @@ import json
 import logging
 import math
 import os
+import socket
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
@@ -52,6 +54,7 @@ from ..obs import (
     span as obs_span,
 )
 from ..predict import create_predictor
+from ..resilience import chaos_point, retry_call
 from .gates import GateReport, evaluate_gates, health_counters, health_delta, holdout_loss
 
 log = logging.getLogger("ytklearn_tpu.continual")
@@ -149,13 +152,36 @@ def _rel(root: str, path: str) -> str:
 
 def _copy_file(fs: FileSystem, src: str, dst: str) -> None:
     # chunked: a GBDT dump with stats can run to hundreds of MB, and
-    # retrain copies the incumbent twice (shadow + archive)
-    with fs.open(src) as sf, fs.atomic_open(dst) as df:
-        while True:
-            chunk = sf.read(1 << 20)
-            if not chunk:
-                break
-            df.write(chunk)
+    # retrain copies the incumbent twice (shadow + archive). The whole
+    # copy is one `continual.copy` retry unit — atomic_open guarantees a
+    # failed attempt leaves dst untouched, so a rerun is exact
+    def _once():
+        chaos_point("continual.copy")
+        with fs.open(src) as sf, fs.atomic_open(dst) as df:
+            while True:
+                chunk = sf.read(1 << 20)
+                if not chunk:
+                    break
+                df.write(chunk)
+
+    retry_call(_once, site="continual.copy")
+
+
+def _replace_file(fs: FileSystem, src: str, dst: str) -> None:
+    """Promotion/restore move under the `continual.promote` retry/chaos
+    site. Idempotent per attempt: when a prior attempt actually landed
+    (src gone, dst present) the rerun is a no-op, so a transient fault
+    anywhere around the (atomic) replace never tears the file set."""
+
+    def _once():
+        chaos_point("continual.promote")
+        if not fs.exists(src):
+            if fs.exists(dst):
+                return  # a previous attempt landed the move
+            raise FileNotFoundError(src)
+        fs.replace(src, dst)
+
+    retry_call(_once, site="continual.promote")
 
 
 def _copy_roots(fs: FileSystem, src_base: str, dst_base: str) -> int:
@@ -177,7 +203,7 @@ def _promote_roots(fs: FileSystem, src_base: str, dst_base: str) -> int:
     for suffix, src_root in _roots(src_base).items():
         dst_root = _roots(dst_base)[suffix]
         for path in _files_under(fs, src_root):
-            fs.replace(path, dst_root + _rel(src_root, path))
+            _replace_file(fs, path, dst_root + _rel(src_root, path))
             n += 1
         if fs.exists(src_root):
             fs.delete(src_root)  # now-empty shadow dir (or stale file)
@@ -202,7 +228,7 @@ def _restore_roots(fs: FileSystem, src_base: str, dst_base: str) -> int:
         restored = set()
         for path in _files_under(fs, src_root):
             rel = _rel(src_root, path)
-            fs.replace(path, dst_root + rel)
+            _replace_file(fs, path, dst_root + rel)
             restored.add(rel)
             n += 1
         for path in _files_under(fs, dst_root):
@@ -211,6 +237,187 @@ def _restore_roots(fs: FileSystem, src_base: str, dst_base: str) -> int:
         if fs.exists(src_root):
             fs.delete(src_root)  # now-empty archive dir (or stale file)
     return n
+
+
+# ---------------------------------------------------------------------------
+# Retrain lock — `<data_path>.retrain.lock`: one retrain at a time per
+# serving model. The lock carries OWNER METADATA (pid, host, heartbeat)
+# and is self-healing: a dead same-host owner is reclaimed immediately, a
+# stale heartbeat (owner host died / got preempted mid-retrain) after
+# YTK_RETRAIN_LOCK_TTL_S — no more "delete the stale lock file and
+# re-run" operator runbook step.
+# ---------------------------------------------------------------------------
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True  # exists, owned by someone else
+    except OSError:
+        return True  # can't tell: assume alive (never steal a live lock)
+    return True
+
+
+class RetrainLock:
+    """Heartbeat-stamped retrain lockfile with dead-owner auto-reclaim."""
+
+    def __init__(self, fs: FileSystem, path: str, ttl_s: Optional[float] = None):
+        self.fs = fs
+        self.path = path
+        self.ttl_s = (
+            float(knobs.get_float("YTK_RETRAIN_LOCK_TTL_S"))
+            if ttl_s is None else float(ttl_s)
+        )
+        self._stop = threading.Event()
+        self._beater: Optional[threading.Thread] = None
+        self._started_at = 0.0
+
+    # -- inspection --------------------------------------------------------
+
+    def read_owner(self) -> Optional[dict]:
+        """The lock's owner record, or None when absent/unreadable (an
+        unreadable lock is a pre-metadata legacy file or debris — both
+        reclaimable; atomic_open writes mean it can't be a torn write)."""
+        if not self.fs.exists(self.path):
+            return None
+        try:
+            with self.fs.open(self.path) as f:
+                return json.load(f)
+        except (json.JSONDecodeError, OSError, ValueError):
+            return None
+
+    def _reclaimable(self, owner: Optional[dict]) -> Optional[str]:
+        """Reason string when the current lock can be reclaimed, else None."""
+        if owner is None:
+            return "unreadable/legacy lock file"
+        age = time.time() - float(owner.get("heartbeat_at", 0.0))
+        if age > self.ttl_s:
+            return (
+                f"heartbeat stale for {age:.0f}s "
+                f"(> YTK_RETRAIN_LOCK_TTL_S={self.ttl_s:.0f}s)"
+            )
+        if owner.get("host") == socket.gethostname():
+            pid = int(owner.get("pid", -1))
+            if pid > 0 and not _pid_alive(pid):
+                return f"owner pid {pid} on this host is dead"
+        return None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def _owned(self, on_read_fault: bool = True) -> bool:
+        """Is the on-disk record OURS? Heartbeats and release must never
+        touch a lock another retrain legitimately reclaimed (e.g. this
+        process was SIGSTOP'd/swapped past the TTL and a cron peer took
+        over). A TRANSIENT read fault is ambiguous, so the caller picks
+        the safe bias via `on_read_fault`: heartbeat/gate/promote assume
+        still-owned (an IO blip must not stop the beat or abort a healthy
+        promotion — the next check retries), while release() assumes NOT
+        owned (uncertainty must never delete what might be a peer's
+        healthy lock; worst case our own lock lingers until TTL)."""
+        if not self.fs.exists(self.path):
+            return False  # absent = released or deleted out from under us
+        try:
+            with self.fs.open(self.path) as f:
+                owner = json.load(f)
+        except (json.JSONDecodeError, ValueError):
+            return False  # a peer's (or legacy) record
+        except OSError:
+            return on_read_fault
+        return (
+            int(owner.get("pid", -1)) == os.getpid()
+            and owner.get("host") == socket.gethostname()
+        )
+
+    def _write(self) -> None:
+        with self.fs.atomic_open(self.path) as f:
+            json.dump({
+                "pid": os.getpid(),
+                "host": socket.gethostname(),
+                "started_at": self._started_at,
+                "heartbeat_at": time.time(),
+            }, f)
+
+    def acquire(self) -> "RetrainLock":
+        if self.fs.exists(self.path):
+            owner = self.read_owner()
+            reason = self._reclaimable(owner)
+            if reason is None:
+                age = time.time() - float((owner or {}).get("heartbeat_at", 0.0))
+                raise RuntimeError(
+                    f"another retrain holds {self.path} "
+                    f"(pid={(owner or {}).get('pid')} "
+                    f"host={(owner or {}).get('host')}, heartbeat {age:.0f}s "
+                    f"old); it auto-reclaims once the owner dies or the "
+                    f"heartbeat stays stale for YTK_RETRAIN_LOCK_TTL_S="
+                    f"{self.ttl_s:.0f}s"
+                )
+            obs_inc("continual.lock_reclaimed")
+            obs_event(
+                "continual.lock_reclaimed", path=self.path, reason=reason,
+                prev_pid=(owner or {}).get("pid"),
+                prev_host=(owner or {}).get("host"),
+            )
+            log.warning("retrain lock %s reclaimed: %s", self.path, reason)
+            # no delete: the reclaim is the atomic replace below — a
+            # delete-then-write window would let a second reclaimer erase
+            # THIS process's freshly-written record and slip past the
+            # read-back arbitration
+        self._started_at = time.time()
+        self._write()
+        # read-back arbitration: two acquirers racing through the
+        # check-then-write window both land an atomic_open replace, but
+        # last-writer-wins leaves exactly ONE owner record — the loser
+        # sees the winner's pid and backs off (plain filesystems offer no
+        # compare-and-swap; this closes all but a vanishing window, and
+        # the heartbeat _owned() check evicts a late loser's beater too)
+        if not self._owned():
+            winner = self.read_owner() or {}
+            raise RuntimeError(
+                f"lost the retrain-lock race for {self.path} to "
+                f"pid={winner.get('pid')} host={winner.get('host')}"
+            )
+        # heartbeat at ttl/3 so one missed beat never looks stale
+        interval = max(self.ttl_s / 3.0, 0.5)
+        self._beater = threading.Thread(
+            target=self._beat_loop, args=(interval,),
+            name="ytk-retrain-lock", daemon=True,
+        )
+        self._beater.start()
+        return self
+
+    def _beat_loop(self, interval: float) -> None:
+        while not self._stop.wait(interval):
+            try:
+                if not self._owned():
+                    obs_inc("continual.lock_lost")
+                    obs_event("continual.lock_lost", path=self.path)
+                    log.warning(
+                        "retrain lock %s is no longer ours (reclaimed by a "
+                        "peer after a stall?); stopping the heartbeat — "
+                        "promotion will re-verify ownership and abort",
+                        self.path,
+                    )
+                    return
+                self._write()
+            except Exception:  # noqa: BLE001 — the beater must survive
+                log.exception("retrain lock heartbeat write failed")
+
+    def release(self) -> None:
+        self._stop.set()
+        if self._beater is not None:
+            self._beater.join(timeout=5.0)
+            self._beater = None
+        if self._owned(on_read_fault=False):
+            self.fs.delete(self.path)
+        elif self.fs.exists(self.path):
+            log.warning(
+                "retrain lock %s belongs to another retrain (or is "
+                "unreadable) at release; leaving it in place — a stale "
+                "leftover self-heals at the TTL", self.path,
+            )
 
 
 # ---------------------------------------------------------------------------
@@ -363,15 +570,10 @@ def retrain(
     # one retrain at a time per serving model: overlapping runs (e.g.
     # cron-driven) would share the same shadow path, and the second run's
     # shadow reset could hand the first run's gate a half-trained
-    # candidate to promote
-    lock_path = params.model.data_path + LOCK_SUFFIX
-    if fs.exists(lock_path):
-        raise RuntimeError(
-            f"another retrain holds {lock_path}; if its process is gone, "
-            "delete the stale lock file and re-run"
-        )
-    with fs.atomic_open(lock_path) as f:
-        f.write(f"pid={os.getpid()} t={time.time():.0f}\n")
+    # candidate to promote. The lock is heartbeat-stamped and self-healing
+    # (dead-owner / stale-heartbeat auto-reclaim) — a preempted retrain
+    # never needs an operator to clean up after it.
+    lock = RetrainLock(fs, params.model.data_path + LOCK_SUFFIX).acquire()
     obs_was_enabled = obs_enabled()
     if not obs_was_enabled:
         # the health gate reads sentinel counter deltas; collection must be
@@ -380,15 +582,14 @@ def retrain(
     try:
         return _retrain_locked(
             model_name, family, params, cfg, fs, mesh, mode, extra_rounds,
-            transform_hook, candidate_hook,
+            transform_hook, candidate_hook, lock=lock,
         )
     finally:
         if not obs_was_enabled:
             # scoped enable: a YTK_OBS=0 operator's embedding process must
             # not keep accumulating spans/events after the retrain returns
             obs_configure(enabled=False)
-        if fs.exists(lock_path):
-            fs.delete(lock_path)
+        lock.release()
 
 
 def _retrain_locked(
@@ -402,6 +603,7 @@ def _retrain_locked(
     extra_rounds: Optional[int],
     transform_hook: Optional[Callable],
     candidate_hook: Optional[Callable[[str], None]],
+    lock: Optional["RetrainLock"] = None,
 ) -> RetrainResult:
     t0 = time.time()
     cp = params.continual
@@ -498,6 +700,18 @@ def _retrain_locked(
                 create_predictor(model_name, _eval_cfg(shadow_cfg, family), fs),
                 test_paths,
             )
+    if lock is not None and not lock._owned():
+        # this run stalled past the TTL and a peer reclaimed the lock —
+        # abort before gating: the shadow may now be interleaved with the
+        # peer's writes. (Residual window: writes this run issued WHILE
+        # stalled can land in the peer's shadow before either side
+        # notices; a plain filesystem lock cannot close that without
+        # compare-and-swap, which is why the TTL defaults to 15 minutes.)
+        raise RuntimeError(
+            f"retrain lock {lock.path} was reclaimed by a peer during "
+            "candidate training (stalled past YTK_RETRAIN_LOCK_TTL_S?); "
+            "aborting before the gate — the incumbent keeps serving"
+        )
     health_hits = health_delta(health_before)
     # health.retrace is a SERVING-health signal: candidate training can't
     # fire it (its compiles ride compile_credit), but an in-process
@@ -532,6 +746,15 @@ def _retrain_locked(
         return result
 
     # ---- promote --------------------------------------------------------
+    if lock is not None and not lock._owned():
+        # this run stalled past the lock TTL and a peer reclaimed it: the
+        # peer may be mid-retrain on the same shadow path, so OUR candidate
+        # is no longer trustworthy — abort before touching the live model
+        raise RuntimeError(
+            f"retrain lock {lock.path} was reclaimed by a peer during "
+            "candidate training (stalled past YTK_RETRAIN_LOCK_TTL_S?); "
+            "aborting before promotion — the incumbent keeps serving"
+        )
     new_version = version + 1 if incumbent else version
     with obs_span("continual.promote", version=new_version):
         archives = [int(v) for v in vinfo.get("archives", [])]
@@ -545,7 +768,7 @@ def _retrain_locked(
                 _delete_roots(fs, f"{data_path}.v{archives.pop(0)}")
         n_moved = _promote_roots(fs, shadow_path, data_path)
         if fi_path and fs.exists(fi_path + SHADOW_SUFFIX):
-            fs.replace(fi_path + SHADOW_SUFFIX, fi_path)
+            _replace_file(fs, fi_path + SHADOW_SUFFIX, fi_path)
         _write_version(fs, data_path, {
             "version": new_version,
             "promoted_at": time.time(),

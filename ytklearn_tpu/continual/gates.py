@@ -26,7 +26,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..obs import snapshot as obs_snapshot, span as obs_span
+from ..config import knobs
+from ..obs import inc as obs_inc, snapshot as obs_snapshot, span as obs_span
 from ..predict.base import parse_feature_kvs
 
 log = logging.getLogger("ytklearn_tpu.continual")
@@ -52,13 +53,40 @@ def health_delta(before: Dict[str, float]) -> Dict[str, float]:
     return out
 
 
+def _gate_scores(predictor, fmaps: List[dict], compiled: Optional[bool]) -> np.ndarray:
+    """Score the held-out rows. Default path: CompiledScorer — the same
+    batched jit kernels serving uses, executed on the padded shape ladder
+    (rungs compile lazily; only the sizes this eval touches pay a
+    compile). This closes r12's known limitation: the per-row host walk
+    cost the gate minutes at real holdout sizes. `YTK_GATE_COMPILED=0`
+    (or compiled=False) keeps the host row walk; a family the scorer
+    cannot lower falls back loudly (`continual.gate_eval_fallback`)."""
+    if compiled is None:
+        compiled = knobs.get_bool("YTK_GATE_COMPILED")
+    if compiled:
+        try:
+            from ..serve.scorer import CompiledScorer
+
+            scorer = CompiledScorer(predictor, warmup=False)
+            return np.asarray(scorer.score_batch(fmaps), np.float64)
+        except Exception as e:  # noqa: BLE001 — eval must not lose the gate
+            obs_inc("continual.gate_eval_fallback")
+            log.warning(
+                "gate eval: CompiledScorer path failed (%s: %s); falling "
+                "back to the host row walk", type(e).__name__, e,
+            )
+    return np.asarray(predictor.batch_scores(fmaps), np.float64)
+
+
 def holdout_loss(
-    predictor, paths: Sequence[str], max_error_tol: int = 100
+    predictor, paths: Sequence[str], max_error_tol: int = 100,
+    compiled: Optional[bool] = None,
 ) -> Tuple[float, int]:
     """Weighted average loss of `predictor` over labeled held-out files
-    (weight###label###features rows, the training text format). Row walks
-    are host numpy; the loss activates in ONE batched call. Returns
-    (avg_loss, n_rows); (nan, 0) when no labeled rows were found."""
+    (weight###label###features rows, the training text format). Scoring
+    goes through CompiledScorer (see _gate_scores); the loss activates in
+    ONE batched call. Returns (avg_loss, n_rows); (nan, 0) when no
+    labeled rows were found."""
     delim = predictor.params.data.delim
     fs = predictor.fs
     fmaps: List[dict] = []
@@ -92,7 +120,7 @@ def holdout_loss(
     if not fmaps:
         return float("nan"), 0
     with obs_span("continual.holdout_eval", rows=len(fmaps)):
-        scores = np.asarray(predictor.batch_scores(fmaps), np.float64)
+        scores = _gate_scores(predictor, fmaps, compiled)
         k = scores.shape[1] if scores.ndim > 1 else 1
         if k > 1:
             lab = np.zeros((len(labels), k), np.float64)

@@ -57,6 +57,7 @@ from ..obs import (
     span as obs_span,
 )
 from ..parallel.mesh import row_sharding
+from ..resilience import chaos_point, trainer_guard
 from .binning import (
     FeatureBins,
     bin_matrix,
@@ -264,11 +265,18 @@ class GBDTTrainer:
         p = self.params
         return (p.l1, p.l2, p.min_child_hessian_sum, p.max_abs_leaf_val)
 
-    def _load_resume_model(self, model: GBDTModel, K: int):
+    def _load_resume_model(self, model: GBDTModel, K: int, feature_names=None):
         """continue_train reload (reference: GBDTOptimizer.java:408 resume at
         trees/K). Rank0 reads, every rank resumes from rank0's text — dumps
         are rank0-only, so on non-shared storage other ranks would
-        otherwise silently start from scratch and corrupt the run."""
+        otherwise silently start from scratch and corrupt the run.
+
+        Tree.parse leaves `feat` at 0 for non-numeric feature names
+        ("resolved later via feature dict"); the resolution happens HERE
+        against the ingest column order — without it every resumed score
+        replay routed through column 0, so warm starts trained against a
+        corrupted residual (found by the preemption bit-identity pin,
+        tests/test_resilience.py)."""
         p = self.params
         if not p.model.continue_train:
             return model, 0
@@ -284,6 +292,21 @@ class GBDTTrainer:
         if text is None:
             return model, 0
         model = GBDTModel.loads(text)
+        if feature_names:
+            index = {n: i for i, n in enumerate(feature_names)}
+            for t in model.trees:
+                for nid in range(t.n_nodes()):
+                    if t.is_leaf(nid):
+                        continue
+                    fid = index.get(t.feat_name[nid])
+                    if fid is not None:
+                        t.feat[nid] = fid
+                    elif not t.feat_name[nid].isdigit():
+                        raise ValueError(
+                            f"continue_train: dumped split feature "
+                            f"{t.feat_name[nid]!r} is not in this run's "
+                            "feature set — resuming on different data?"
+                        )
         log.info("continue_train: loaded %d trees", len(model.trees))
         return model, len(model.trees) // K
 
@@ -309,15 +332,20 @@ class GBDTTrainer:
         train: Optional[GBDTData] = None,
         test: Optional[GBDTData] = None,
     ) -> GBDTResult:
-        if self.engine == "device":
-            return self._train_device(train, test)
-        if jax.process_count() > 1:
-            raise ValueError(
-                "multi-process GBDT training requires the device engine "
-                "(host-loop makers read per-row device state eagerly); got "
-                f"engine={self.engine!r}"
-            )
-        return self._train_host(train, test)
+        # preemption-safe: SIGTERM/SIGINT defer to the next round
+        # boundary, where the loop dumps an emergency checkpoint through
+        # the ordinary atomic dump path and raises Preempted — `--resume
+        # auto` re-enters here via continue_train (docs/fault_tolerance.md)
+        with trainer_guard(self):
+            if self.engine == "device":
+                return self._train_device(train, test)
+            if jax.process_count() > 1:
+                raise ValueError(
+                    "multi-process GBDT training requires the device engine "
+                    "(host-loop makers read per-row device state eagerly); "
+                    f"got engine={self.engine!r}"
+                )
+            return self._train_host(train, test)
 
     # ======================================================================
     # DEVICE ENGINE
@@ -922,6 +950,14 @@ class GBDTTrainer:
             Tuple[int, jnp.ndarray, Optional[jnp.ndarray], float]
         ] = None
         for rnd in range(start_round, p.round_num):
+            if self._guard is not None and self._guard.triggered:
+                # round boundary = the safe preemption point: fetching the
+                # tree buffers drains every enqueued round, so the dump
+                # holds exactly the completed rounds and the resumed run
+                # re-enters at `rnd` bit-identically (round-indexed RNG)
+                self._preempt_checkpoint(
+                    model, carry[2], dd.bins, feature_names, rnd
+                )
             # enqueue-side span: the round program is async, so this
             # measures dispatch (device time shows up in the sync spans)
             with obs_span("gbdt.round", round=rnd):
@@ -1001,7 +1037,9 @@ class GBDTTrainer:
             num_tree_in_group=K,
             obj_name=self.loss.name,
         )
-        model, start_round = self._load_resume_model(model, K)
+        model, start_round = self._load_resume_model(
+            model, K, feature_names=train.feature_names
+        )
         scores, scores_t = self._init_device_scores(model, dd, base_np)
         bufs, loss_buf, tloss_buf = self._make_tree_bufs(spec.max_nodes)
 
@@ -1072,12 +1110,32 @@ class GBDTTrainer:
         else:
             self._retrace.check(round=rnd)
 
+    def _preempt_checkpoint(self, model, bufs, bins, names, rnd: int) -> None:
+        """Emergency checkpoint at round boundary `rnd`, then Preempted."""
+        self._append_trees_from_bufs(
+            model, bufs, bins, names, len(model.trees), rnd * self.K
+        )
+        self._dump_model(model)
+        if knobs.get_str("YTK_PROFILE_DIR"):
+            # the Preempted raise skips the post-loop stop_trace: close the
+            # profiler here or the very run being profiled loses its trace
+            try:
+                jax.profiler.stop_trace()
+            # ytklint: allow(broad-except) reason=a profiler teardown failure must not block the emergency checkpoint exit
+            except Exception as e:
+                log.warning("profiler stop at preemption failed: %s", e)
+        self._guard.preempt(
+            self.params.model.data_path, family="gbdt", rounds=rnd,
+            trees=len(model.trees),
+        )
+
     def _emit_sync(self, pending, t0) -> None:
         """Materialize a lagged sync record (round, loss slice[, test]).
         The logged time is the round's sync-point host timestamp carried in
         `pending` — emission happens one window later, which would skew
         absolute per-round times late (steady-state trees/s uses diffs and
         is insensitive either way)."""
+        chaos_point("gbdt.sync")
         rnd, loss_dev, tloss_dev, t_sync = pending
         obs_inc("gbdt.syncs")
         with obs_span("gbdt.sync", round=rnd, lagged=True):
@@ -1097,6 +1155,7 @@ class GBDTTrainer:
         The final round skips the watch log: _finalize_device evaluates
         the same final scores anyway."""
         p = self.params
+        chaos_point("gbdt.sync")
         obs_inc("gbdt.syncs")
         with obs_span("gbdt.sync", round=rnd, lagged=False):
             tl = float(carry[3][rnd])  # syncs the pipeline
@@ -1491,7 +1550,9 @@ class GBDTTrainer:
         )
 
         # continue_train: reload + replay scores
-        model, start_round = self._load_resume_model(model, K)
+        model, start_round = self._load_resume_model(
+            model, K, feature_names=train.feature_names
+        )
 
         if K > 1:
             scores = jnp.full((n, K), base_np, jnp.float32)
@@ -1532,6 +1593,14 @@ class GBDTTrainer:
             )
 
         for rnd in range(start_round, p.round_num):
+            if self._guard is not None and self._guard.triggered:
+                # host engine appends converted trees as it goes: the dump
+                # is the checkpoint, resume re-enters at this round
+                self._dump_model(model)
+                self._guard.preempt(
+                    p.model.data_path, family="gbdt_host", rounds=rnd,
+                    trees=len(model.trees),
+                )
             # fast-path grads from predictions (reference:
             # ILossFunction.getDerivativeFast, GBDTOptimizer:513)
             preds = self.loss.predict(scores)
@@ -1636,6 +1705,7 @@ class GBDTTrainer:
     _missing_fill: Optional[np.ndarray] = None
     _efb_plan = None  # BundlePlan when EFB merged columns this run
     _replay_bins = None  # transient pre-bundle matrices for warm-start replay
+    _guard = None  # PreemptionGuard while train() runs (resilience/preempt.py)
 
     def _tree_scores_from_raw(self, tree: Tree, bins: FeatureBins, bins_dev):
         """Score a converted (value-space) tree against the bin matrix by

@@ -53,7 +53,11 @@ class FileSystem:
         content or carries the complete new content — a reader (e.g. the
         serving registry's fingerprint watcher) can never observe a
         half-written file. On error the temp file is removed and `path`
-        is untouched."""
+        is untouched. The commit (replace) rides the `io.dump` retry/
+        chaos site: a transient fault at the rename costs a backoff, not
+        the checkpoint."""
+        from ..resilience import chaos_point, retry_call
+
         tmp = f"{path}{TMP_MARKER}{os.getpid()}"
         f = self.open(tmp, mode)
         try:
@@ -67,7 +71,20 @@ class FileSystem:
                 pass
             raise
         f.close()
-        self.replace(tmp, path)
+
+        def _commit():
+            chaos_point("io.dump")
+            self.replace(tmp, path)
+
+        try:
+            retry_call(_commit, site="io.dump")
+        except BaseException:
+            try:
+                self.delete(tmp)
+            # ytklint: allow(broad-except) reason=cleanup of the temp file is best-effort; the commit failure below is what matters
+            except Exception:
+                pass
+            raise
 
     def recur_get_paths(self, paths: Sequence[str]) -> List[str]:
         """Expand directories (recursively) and globs into a flat file list
@@ -77,11 +94,22 @@ class FileSystem:
     # -- line-oriented helpers used by the data layer --------------------
 
     def read_lines(self, paths: Sequence[str]) -> Iterator[str]:
-        """All lines of all files, in sorted-path order."""
+        """All lines of all files, in sorted-path order. Streaming, with
+        each file under the `io.read` retry/chaos site: a transient fault
+        (at open or mid-read) reopens that one file and skips the
+        already-yielded lines instead of killing the run — no line is
+        ever yielded twice and peak memory stays O(one line)
+        (resilience.retry.retry_lines)."""
+        from ..resilience import chaos_point, retry_lines
+
         for p in sorted(self.recur_get_paths(paths)):
-            with self.open(p) as f:
-                for line in f:
-                    yield line.rstrip("\n")
+
+            def _open(path=p):
+                chaos_point("io.read")
+                return self.open(path)
+
+            for line in retry_lines(_open, site="io.read"):
+                yield line.rstrip("\n")
 
     def select_read_lines(
         self, paths: Sequence[str], divisor: int, remainder: int

@@ -216,11 +216,19 @@ def parse_paths(
     The line-modulo shard phase carries across file boundaries: every
     physical line counts, and each file is newline-normalized, so file k
     starts at global line sum(lines of files < k)."""
+    from ..resilience import chaos_point, retry_call
+
     blocks: List[ParsedBlock] = []
     line0 = 0
     for p in sorted(fs.recur_get_paths(paths)):
-        with fs.open(p, "rb") as f:
-            b = f.read()
+        # same `io.read` retry/chaos seam as FileSystem.read_lines: a
+        # transient fault rereads this one file, never kills the run
+        def _read(path=p) -> bytes:
+            chaos_point("io.read")
+            with fs.open(path, "rb") as f:
+                return f.read()
+
+        b = retry_call(_read, site="io.read")
         if not b:
             continue
         if not b.endswith(b"\n"):

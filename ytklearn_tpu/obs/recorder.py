@@ -10,6 +10,10 @@ abnormal-exit paths:
                    hook (the traceback still prints)
   SIGTERM          dump, restore the previous handler, re-raise the signal
                    (exit status is still the signal's)
+  SIGINT           dump, then hand back to the previous disposition — a
+                   Ctrl-C postmortem gets the same flight dump a SIGTERM
+                   does (the python default still raises KeyboardInterrupt
+                   afterwards, so interactive semantics are unchanged)
   atexit           dump only when an abnormal condition was flagged earlier
                    (a clean exit writes nothing)
 
@@ -62,6 +66,7 @@ class _RecState:
         "dir",
         "prev_excepthook",
         "prev_sigterm",
+        "prev_sigint",
         "abnormal",
         "last_dump_path",
         "config_fingerprint",
@@ -73,6 +78,7 @@ class _RecState:
         self.dir: Optional[str] = None
         self.prev_excepthook = None
         self.prev_sigterm = None
+        self.prev_sigint = None
         self.abnormal = False
         self.last_dump_path: Optional[str] = None
         self.config_fingerprint: Optional[dict] = None
@@ -238,6 +244,22 @@ def _sigterm_handler(signum, frame):
     os.kill(os.getpid(), signal.SIGTERM)
 
 
+def _sigint_handler(signum, frame):
+    _state.abnormal = True
+    dump("sigint")
+    prev = _state.prev_sigint
+    if callable(prev):
+        # the python default (default_int_handler) raises KeyboardInterrupt
+        # from here — exactly the old Ctrl-C semantics, now with a dump
+        signal.signal(signal.SIGINT, prev)
+        prev(signum, frame)
+        return
+    signal.signal(
+        signal.SIGINT, prev if prev is not None else signal.SIG_DFL
+    )
+    os.kill(os.getpid(), signal.SIGINT)
+
+
 def _atexit_handler():
     if _state.abnormal and _state.last_dump_path is None:
         dump("atexit")
@@ -258,8 +280,10 @@ def install(ring_n: Optional[int] = None, flight_dir: Optional[str] = None) -> N
         sys.excepthook = _excepthook
         try:
             _state.prev_sigterm = signal.signal(signal.SIGTERM, _sigterm_handler)
+            _state.prev_sigint = signal.signal(signal.SIGINT, _sigint_handler)
         except ValueError:
             _state.prev_sigterm = None  # non-main thread: excepthook/atexit only
+            _state.prev_sigint = None
         atexit.register(_atexit_handler)
         _state.installed = True
 
@@ -284,6 +308,11 @@ def uninstall() -> None:
             if _state.prev_sigterm is not None:
                 try:
                     signal.signal(signal.SIGTERM, _state.prev_sigterm)
+                except ValueError:
+                    pass
+            if _state.prev_sigint is not None:
+                try:
+                    signal.signal(signal.SIGINT, _state.prev_sigint)
                 except ValueError:
                     pass
             _state.installed = False

@@ -148,6 +148,14 @@ def host_allgather_objects(obj):
     pickled into a padded uint8 buffer first (two rounds: lengths, then
     bytes) — the Kryo-over-TCP objects of the reference's allreduceMap,
     done over DCN. Load-time only; never the hot path."""
+    # `collective.host` fault site: the host-side verbs are the ones a
+    # flaky DCN / dying peer actually breaks, and (unlike the traced ICI
+    # verbs) a python-level injection here is observable. No retry — a
+    # rank re-entering a collective alone would desync the group, so a
+    # fault here is fatal by design and the flight event names it.
+    from ..resilience import chaos_point
+
+    chaos_point("collective.host")
     if jax.process_count() == 1:
         return [obj]
     import pickle

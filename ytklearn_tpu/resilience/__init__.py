@@ -1,0 +1,68 @@
+"""ytklearn_tpu.resilience — the fault-tolerance layer (docs/fault_tolerance.md).
+
+Three pillars over the r8 flight recorder + r12 atomic dumps:
+
+  chaos     deterministic fault injection: named `chaos_point(site)`
+            seams armed by `YTK_CHAOS=<site>:<kind>:<rate>:<seed>` with
+            counter-based draws — every injected fault reproduces
+            exactly and leaves an obs counter + flight-ring event
+  retry     `retry_call(fn, site)` — exponential backoff, deterministic
+            jitter, typed transient-vs-fatal classification,
+            `io.retry.*` evidence; the one sanctioned retry loop
+            (ytklint `sleep-in-except` forbids ad-hoc ones)
+  preempt   `PreemptionGuard` — SIGTERM/SIGINT deferred to the next safe
+            training boundary, emergency checkpoint through the existing
+            atomic dump paths, `Preempted` -> exit 128+signum; the
+            relaunch resumes via `--resume auto` (GBDT: bit-identical)
+
+Knobs: YTK_CHAOS, YTK_RETRY_{MAX,BASE_S,MAX_S}, YTK_PREEMPT.
+Drill: scripts/chaos_drill.py proves the whole loop end to end.
+"""
+
+from __future__ import annotations
+
+from .chaos import (  # noqa: F401
+    FAULT_SITES,
+    KINDS,
+    ChaosError,
+    ChaosOSError,
+    ChaosRule,
+    chaos_enabled,
+    chaos_point,
+    parse_chaos_spec,
+    reset_chaos,
+    site_draw,
+)
+from .preempt import (  # noqa: F401
+    Preempted,
+    PreemptionGuard,
+    preemption_guard,
+    trainer_guard,
+)
+from .retry import (  # noqa: F401
+    RetryPolicy,
+    is_transient,
+    retry_call,
+    retry_lines,
+)
+
+__all__ = [
+    "FAULT_SITES",
+    "KINDS",
+    "ChaosError",
+    "ChaosOSError",
+    "ChaosRule",
+    "Preempted",
+    "PreemptionGuard",
+    "RetryPolicy",
+    "chaos_enabled",
+    "chaos_point",
+    "is_transient",
+    "parse_chaos_spec",
+    "preemption_guard",
+    "reset_chaos",
+    "retry_call",
+    "retry_lines",
+    "site_draw",
+    "trainer_guard",
+]

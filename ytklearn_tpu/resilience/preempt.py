@@ -1,0 +1,179 @@
+"""Preemption-safe exit: deferred SIGTERM/SIGINT + emergency checkpoint.
+
+Preemptible TPU VMs get a SIGTERM and a short grace window; the old
+answer was the flight recorder's dump-and-die. Now trainers run under a
+`PreemptionGuard`:
+
+  1. the handler only SETS A FLAG (async-signal-safe: no locks, no IO —
+     the registry lock the recorder has to tiptoe around is never touched
+     from the handler here),
+  2. the training loop checks the flag at its next *safe boundary* (GBDT
+     round boundary, L-BFGS iteration callback, GBST tree boundary),
+     dumps a complete checkpoint through the existing atomic dump path,
+     writes a flight dump (reason=preempt) when the recorder is
+     installed, and raises `Preempted`,
+  3. the CLI maps `Preempted` to the conventional 128+signum exit code
+     (143 for SIGTERM, 130 for SIGINT) and logs the resume line;
+     `--resume auto` on the relaunch finds the checkpoint and re-enters
+     training through the existing continue_train machinery.
+
+GBDT resume is *bit-identical* to the uninterrupted run: the round
+cursor derives from the dumped tree count and every per-round RNG key is
+`fold_in(root_key, absolute_round)`, so nothing depends on where the run
+was cut (pinned in tests/test_resilience.py). Convex families resume as
+an L-BFGS warm start from the checkpoint weights; GBST resumes at the
+last finished tree.
+
+A second SIGINT escalates to the previous handler (the operator's double
+Ctrl-C still kills a hung run immediately); SIGTERM stays deferred —
+preemption only sends it once and the boundary is the safest exit.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import signal
+import threading
+from typing import Dict, Iterator, Optional
+
+from ..config import knobs
+from ..obs import event as obs_event, inc as obs_inc, recorder
+
+log = logging.getLogger("ytklearn_tpu.resilience")
+
+
+class Preempted(RuntimeError):
+    """Training exited early on a deferred SIGTERM/SIGINT after dumping
+    an emergency checkpoint; `exit_code` is the conventional 128+signum."""
+
+    def __init__(self, signum: int, checkpoint: str = ""):
+        name = signal.Signals(signum).name if signum else "signal"
+        msg = f"preempted by {name}"
+        if checkpoint:
+            msg += f"; emergency checkpoint at {checkpoint}"
+        super().__init__(msg)
+        self.signum = signum
+        self.checkpoint = checkpoint
+
+    @property
+    def exit_code(self) -> int:
+        return 128 + self.signum
+
+
+class PreemptionGuard:
+    """Deferred-signal flag + the boundary-side exit helper."""
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._signum: Optional[int] = None
+        self._counts: Dict[int, int] = {}
+        self._prev: Dict[int, object] = {}
+        self.installed = False
+
+    # -- handler side (async-signal-safe: flag + counters only) -----------
+
+    def _handler(self, signum, frame):
+        first_of_kind = self._counts.get(signum, 0) == 0
+        self._counts[signum] = self._counts.get(signum, 0) + 1
+        if self._signum is None:
+            self._signum = signum
+        self._event.set()
+        if signum == signal.SIGINT and not first_of_kind:
+            # second Ctrl-C: the operator means NOW — hand back to the
+            # previous disposition (recorder hook / python default)
+            prev = self._prev.get(signum)
+            if callable(prev):
+                signal.signal(signal.SIGINT, prev)
+                prev(signum, frame)
+                return
+            raise KeyboardInterrupt
+
+    def install(self) -> "PreemptionGuard":
+        """Hook SIGTERM+SIGINT (idempotent). Off the main thread
+        signal.signal is unavailable — the guard stays inert and
+        `triggered` is always False, so a retrain embedded in a server
+        thread trains exactly as before."""
+        if self.installed:
+            return self
+        try:
+            for sig in (signal.SIGTERM, signal.SIGINT):
+                self._prev[sig] = signal.signal(sig, self._handler)
+            self.installed = True
+        except ValueError:
+            self._prev.clear()
+        return self
+
+    def uninstall(self) -> None:
+        if not self.installed:
+            return
+        for sig, prev in self._prev.items():
+            try:
+                signal.signal(sig, prev if prev is not None else signal.SIG_DFL)
+            except (ValueError, TypeError):
+                pass
+        self._prev.clear()
+        self.installed = False
+
+    # -- boundary side -----------------------------------------------------
+
+    @property
+    def triggered(self) -> bool:
+        return self._event.is_set()
+
+    @property
+    def signum(self) -> int:
+        return self._signum or signal.SIGTERM
+
+    def preempt(self, checkpoint: str = "", **attrs) -> None:
+        """Record the evidence and raise `Preempted`. Call AFTER the
+        emergency checkpoint dump so the resume line names a complete
+        model; the flight dump (when the recorder is installed) carries
+        the chaos/retry/preempt event trail for the postmortem."""
+        obs_inc("preempt.exits")
+        obs_event(
+            "preempt.checkpoint", signum=self.signum,
+            checkpoint=checkpoint, **attrs,
+        )
+        if recorder.installed():
+            recorder.dump("preempt")
+        log.warning(
+            "preempted (signal %d): emergency checkpoint %s — rerun with "
+            "--resume auto to continue", self.signum, checkpoint or "n/a",
+        )
+        raise Preempted(self.signum, checkpoint)
+
+
+@contextlib.contextmanager
+def preemption_guard(enabled: Optional[bool] = None) -> Iterator[Optional[PreemptionGuard]]:
+    """Install a guard for the duration of a training loop (YTK_PREEMPT=0
+    opts out -> yields None and the loop runs with the process's existing
+    signal dispositions)."""
+    if enabled is None:
+        enabled = knobs.get_bool("YTK_PREEMPT")
+    if not enabled:
+        yield None
+        return
+    guard = PreemptionGuard().install()
+    try:
+        yield guard
+    finally:
+        guard.uninstall()
+
+
+@contextlib.contextmanager
+def trainer_guard(trainer) -> Iterator[Optional[PreemptionGuard]]:
+    """THE trainer-entry hook: flight-recorder hooks first, then the
+    guard, with `trainer._guard` set for the loop's boundary checks. The
+    install order is a LIFO invariant — the guard uninstalls at train
+    end and must hand the signals back to the RECORDER'S handlers, not
+    the other way around (a recorder installed second would chain to a
+    dead guard handler after training). Keeping it here means every
+    trainer gets the ordering right by construction."""
+    recorder.auto_install()
+    with preemption_guard() as guard:
+        trainer._guard = guard
+        try:
+            yield guard
+        finally:
+            trainer._guard = None

@@ -1,0 +1,195 @@
+"""Retry/backoff — the one transient-fault primitive the whole tree uses.
+
+Before this module a transient `OSError` anywhere (an ingest shard read
+off flaky storage, a continual promotion move, a serve warm load) killed
+the run or stranded a reload until the next poll. Now every such seam
+routes through `retry_call`:
+
+  - *typed classification*: `is_transient` retries plain OSErrors (EIO,
+    connection resets, timeouts — what preemptible storage actually
+    throws) but never the fatal shapes (FileNotFoundError & friends,
+    where retrying only delays the real error) and never non-IO bugs
+  - *exponential backoff with deterministic jitter*: delay for attempt k
+    is `min(max_s, base_s * 2^(k-1))` scaled into [0.5, 1.0) by a
+    counter-hash of (site, k) — no host RNG, so two runs back off
+    identically and a test can pin the schedule
+  - *evidence*: `io.retry.attempts` / `io.retry.<site>` /
+    `io.retry.recovered` / `io.retry.giveup` counters plus `io.retry`
+    trace events, so a postmortem shows exactly which seam flapped
+
+The ytklint `sleep-in-except` rule forbids ad-hoc `time.sleep` retry
+loops everywhere else in the tree — this module is the one sanctioned
+implementation (docs/fault_tolerance.md).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional, TypeVar
+
+from ..config import knobs
+from ..obs import event as obs_event, inc as obs_inc
+from .chaos import ChaosError, site_draw
+
+log = logging.getLogger("ytklearn_tpu.resilience")
+
+T = TypeVar("T")
+
+_JITTER_SEED = 0x5EED  # fixed: jitter must reproduce across runs
+
+#: OSError shapes where a retry can only re-raise the same answer slower
+_FATAL_OS = (
+    FileNotFoundError,
+    IsADirectoryError,
+    NotADirectoryError,
+    PermissionError,
+    FileExistsError,
+)
+
+
+def is_transient(exc: BaseException) -> bool:
+    """Default transient-vs-fatal classification. Transient: OSError
+    (incl. ConnectionError/TimeoutError/Interrupted) and EOFError, minus
+    the fatal OSError shapes above. ChaosError (kind=error) is fatal by
+    construction — the drill's proof that classification is typed, not
+    catch-all."""
+    if isinstance(exc, ChaosError):
+        return False
+    if isinstance(exc, _FATAL_OS):
+        return False
+    return isinstance(exc, (OSError, EOFError))
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    max_attempts: int = 4
+    base_s: float = 0.05
+    max_s: float = 2.0
+    multiplier: float = 2.0
+
+    @classmethod
+    def from_knobs(cls) -> "RetryPolicy":
+        return cls(
+            max_attempts=max(int(knobs.get_int("YTK_RETRY_MAX")), 1),
+            base_s=max(float(knobs.get_float("YTK_RETRY_BASE_S")), 0.0),
+            max_s=max(float(knobs.get_float("YTK_RETRY_MAX_S")), 0.0),
+        )
+
+    def delay_s(self, attempt: int, site: str) -> float:
+        """Backoff before retry `attempt+1` (attempt is 1-based): capped
+        exponential, deterministically jittered into [0.5, 1.0)x."""
+        raw = min(self.max_s, self.base_s * self.multiplier ** (attempt - 1))
+        return raw * (0.5 + 0.5 * site_draw(_JITTER_SEED, site, attempt))
+
+
+def _backoff_or_reraise(
+    e: BaseException,
+    attempt: int,
+    policy: RetryPolicy,
+    site: str,
+    classify: Callable[[BaseException], bool],
+    context: str = "",
+) -> None:
+    """The one classify/budget/evidence/backoff block (shared by
+    retry_call and retry_lines so the policy can never diverge). Called
+    from inside an except handler: re-raises fatal exceptions and
+    exhausted budgets (with the `io.retry.giveup` record), otherwise
+    records the attempt evidence and sleeps the jittered backoff."""
+    if not classify(e):
+        raise
+    if attempt >= policy.max_attempts:
+        obs_inc("io.retry.giveup")
+        obs_event(
+            "io.retry.giveup", site=site, attempts=attempt,
+            error=f"{type(e).__name__}: {e}"[:200],
+        )
+        log.error(
+            "retry[%s]: giving up after %d attempts: %s: %s",
+            site, attempt, type(e).__name__, e,
+        )
+        raise
+    delay = policy.delay_s(attempt, site)
+    obs_inc("io.retry.attempts")
+    obs_inc(f"io.retry.{site}")
+    obs_event(
+        "io.retry", site=site, attempt=attempt,
+        delay_s=round(delay, 4), error=type(e).__name__,
+    )
+    log.warning(
+        "retry[%s]: attempt %d/%d failed%s (%s: %s); backing off %.3fs",
+        site, attempt, policy.max_attempts, context,
+        type(e).__name__, e, delay,
+    )
+    time.sleep(delay)
+
+
+def _record_recovered(site: str, attempt: int) -> None:
+    obs_inc("io.retry.recovered")
+    obs_event("io.retry.recovered", site=site, attempts=attempt)
+    log.info("retry[%s]: recovered on attempt %d", site, attempt)
+
+
+def retry_call(
+    fn: Callable[[], T],
+    site: str,
+    policy: Optional[RetryPolicy] = None,
+    classify: Callable[[BaseException], bool] = is_transient,
+) -> T:
+    """Run `fn()` with transient-fault retries. `site` names the seam in
+    counters/events (conventionally a FAULT_SITES name, so the chaos site
+    and its retry evidence line up). Fatal exceptions propagate on the
+    first throw; transient ones propagate after the attempt budget with
+    an `io.retry.giveup` record."""
+    policy = policy or RetryPolicy.from_knobs()
+    attempt = 0
+    while True:
+        attempt += 1
+        try:
+            out = fn()
+        except Exception as e:
+            _backoff_or_reraise(e, attempt, policy, site, classify)
+            continue
+        if attempt > 1:
+            _record_recovered(site, attempt)
+        return out
+
+
+def retry_lines(
+    open_fn: Callable[[], object],
+    site: str,
+    policy: Optional[RetryPolicy] = None,
+    classify: Callable[[BaseException], bool] = is_transient,
+):
+    """Stream lines from a re-openable source with transient-fault
+    retries at O(1) memory: on a mid-read transient failure the source is
+    reopened and the already-yielded line count is skipped, so no line is
+    ever yielded twice and no file is ever held whole in memory (the
+    generator twin of `retry_call`; `FileSystem.read_lines` rides it)."""
+    policy = policy or RetryPolicy.from_knobs()
+    attempt = 0
+    yielded = 0
+    while True:
+        attempt += 1
+        try:
+            f = open_fn()
+            try:
+                skip = yielded
+                for line in f:
+                    if skip:
+                        skip -= 1
+                        continue
+                    yielded += 1
+                    yield line
+            finally:
+                f.close()
+        except Exception as e:
+            _backoff_or_reraise(
+                e, attempt, policy, site, classify,
+                context=f" mid-stream after {yielded} lines",
+            )
+            continue
+        if attempt > 1:
+            _record_recovered(site, attempt)
+        return

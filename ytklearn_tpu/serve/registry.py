@@ -44,6 +44,7 @@ from ..config import knobs
 from ..io.fs import is_tmp_path
 from ..obs import event as obs_event, gauge as obs_gauge, inc as obs_inc
 from ..predict import create_predictor
+from ..resilience import chaos_point, retry_call
 from .scorer import CompiledScorer
 
 log = logging.getLogger("ytklearn_tpu.serve")
@@ -145,8 +146,18 @@ class ModelRegistry:
         return entry
 
     def _build(self, name, model_name, config, version) -> _Entry:
-        predictor = create_predictor(model_name, config)
-        scorer = CompiledScorer(predictor, ladder=self.ladder, warmup=True)
+        # `serve.load` retry/chaos site: a transient read fault off the
+        # model store used to strand the reload until the next poll tick
+        # (or fail the initial load outright) — now it costs a backoff.
+        # Fatal faults (parse errors, missing files) still propagate to
+        # maybe_reload's keep-serving handler on the first throw.
+        def _once():
+            chaos_point("serve.load")
+            predictor = create_predictor(model_name, config)
+            scorer = CompiledScorer(predictor, ladder=self.ladder, warmup=True)
+            return predictor, scorer
+
+        predictor, scorer = retry_call(_once, site="serve.load")
         return _Entry(
             name, model_name, config, predictor, scorer,
             model_fingerprint(predictor), version,

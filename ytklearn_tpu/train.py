@@ -35,6 +35,7 @@ from .obs import (
     span as obs_span,
 )
 from .optimize import LBFGSConfig, inv_hessian_vp, minimize_lbfgs
+from .resilience import trainer_guard
 
 log = logging.getLogger("ytklearn_tpu.train")
 
@@ -136,7 +137,17 @@ class HoagTrainer:
         host = model.make_batch(ds)
         return tuple(put_row_sharded(a, self.mesh) for a in host)
 
+    _guard = None  # PreemptionGuard while train() runs (resilience/preempt.py)
+
     def train(self, ingest: Optional[IngestResult] = None) -> TrainResult:
+        # preemption-safe: SIGTERM/SIGINT defer to the next L-BFGS
+        # iteration callback, which dumps the current weights through the
+        # ordinary checkpoint path and raises Preempted; the relaunch
+        # resumes as a continue_train warm start (docs/fault_tolerance.md)
+        with trainer_guard(self):
+            return self._train_impl(ingest)
+
+    def _train_impl(self, ingest: Optional[IngestResult] = None) -> TrainResult:
         p = self.params
         t0 = time.time()
         ts = self.time_stats = {}  # phase counters (data/gbdt/TimeStats.java
@@ -323,6 +334,20 @@ class HoagTrainer:
                     rec["avg_loss"],
                     f" test avg loss={rec['test_loss']:.6f}" if "test_loss" in rec else "",
                 )
+                if self._guard is not None and self._guard.triggered:
+                    # iteration boundary = the convex safe point: dump the
+                    # current weights (the L-BFGS checkpoint the relaunch
+                    # warm-starts from) and exit via Preempted — checked
+                    # BEFORE the periodic dump so the grace window never
+                    # pays for the same serialization twice
+                    self._dump(
+                        model, state.w, ingest, _l2v, g_weight, train_b,
+                        jit_precision,
+                    )
+                    self._guard.preempt(
+                        p.model.data_path, family=self.model_name,
+                        iteration=it,
+                    )
                 # periodic checkpoint (reference dump_freq block :647-660)
                 if p.model.dump_freq > 0 and it > 0 and it % p.model.dump_freq == 0:
                     self._dump(
