@@ -21,9 +21,14 @@ series:
              chaos.inject events present (in-process, registry-checked)
   serve      registry hot reload under serve.load oserror chaos ->
              reload succeeds after retries, old model never dropped
+  fleet      kill -9 one replica of a live 2-replica serving fleet mid-
+             load: every in-flight request completes (front reroutes to
+             the sibling — zero client-visible failures), the slot
+             restarts, and the flight dump carries the
+             serve.worker.{died,restarted} evidence naming the replica
 
 Usage:
-    python scripts/chaos_drill.py [--out CHAOS_r13.json] [--keep]
+    python scripts/chaos_drill.py [--out CHAOS_r14.json] [--keep]
 
 Exits non-zero when any step fails; the artifact is written either way
 (a failing drill should leave evidence, not vanish).
@@ -131,7 +136,7 @@ def _flight_evidence(doc) -> dict:
 
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
-    ap.add_argument("--out", default="CHAOS_r13.json")
+    ap.add_argument("--out", default="CHAOS_r14.json")
     ap.add_argument("--keep", action="store_true",
                     help="keep the scratch dir for inspection")
     args = ap.parse_args()
@@ -275,6 +280,109 @@ def main() -> int:
     check(swapped, "serve reload did not complete under transient chaos")
     check(after - before >= 1, "serve reload recorded no retries")
     record["steps"]["serve_reload"] = step
+
+    # 7. fleet: kill -9 one replica mid-load ------------------------------
+    # (real `cli serve` workers over the step-1 model; the front must
+    # reroute every in-flight request to the sibling, restart the slot,
+    # and leave serve.worker.{died,restarted} evidence in a flight dump)
+    import signal as _signal
+    import threading
+
+    from ytklearn_tpu.obs import recorder
+    from ytklearn_tpu.serve import BatchPolicy, FleetFront, serve_worker_argv
+
+    recorder.install(flight_dir=os.path.join(work, "flight"))
+    front = FleetFront(
+        serve_worker_argv(
+            _conf(work, "base", 2), "gbdt",
+            ["--watch-interval", "0", "--max-queue", "8192"],
+        ),
+        2,
+        policy=BatchPolicy(max_batch=256, max_wait_ms=0.5, max_queue=8192),
+        ready_timeout_s=600.0,
+        monitor_interval_s=0.1,
+        log_dir=os.path.join(work, "fleet_logs"),
+    ).start()
+    errors, completed = [], [0]
+    stop_evt = threading.Event()
+
+    def hammer(tid: int) -> None:
+        import numpy as np
+
+        r = np.random.RandomState(tid)
+        while not stop_evt.is_set():
+            rows = [{f"c{j}": float(v) for j, v in enumerate(r.randn(8))}]
+            try:
+                out = front.predict(rows, timeout=60.0)
+                assert len(out["scores"]) == 1
+                completed[0] += 1
+            except Exception as e:  # noqa: BLE001 — every failure is a finding
+                errors.append(f"{type(e).__name__}: {e}"[:200])
+
+    threads = [threading.Thread(target=hammer, args=(t,)) for t in range(4)]
+    victim_pid = None
+    try:
+        for t in threads:
+            t.start()
+        time.sleep(0.5)  # traffic provably flowing
+        victim_pid = front.handles[0].pid
+        os.kill(victim_pid, _signal.SIGKILL)
+        deadline = time.time() + 60.0
+        while time.time() < deadline and not (
+            front.handles[0].restarts >= 1
+            and front.handles[0].state == "ready"
+        ):
+            time.sleep(0.05)
+        time.sleep(0.5)  # traffic over the restarted replica too
+    finally:
+        stop_evt.set()
+        for t in threads:
+            t.join(timeout=30.0)
+    snap = obs.snapshot()["counters"]
+    dump_path = recorder.dump("fleet_drill")
+    flight_doc = None
+    if dump_path:
+        with open(dump_path) as f:
+            flight_doc = json.load(f)
+    ring_names = sorted({
+        e.get("name", "")
+        for e in ((flight_doc or {}).get("flight") or {}).get("ring", [])
+    })
+    restarted_ev = next(
+        (e for e in ((flight_doc or {}).get("flight") or {}).get("ring", [])
+         if e.get("name") == "serve.worker.restarted"), None,
+    )
+    step = {
+        "requests_completed": completed[0],
+        "request_failures": len(errors),
+        "failure_samples": errors[:3],
+        "victim_pid": victim_pid,
+        "restarts": front.handles[0].restarts,
+        "replica_state": front.handles[0].state,
+        "worker_died": snap.get("serve.worker.died", 0.0),
+        "worker_restarted": snap.get("serve.worker.restarted", 0.0),
+        "reroutes": snap.get("serve.front.reroutes", 0.0),
+        "flight_dump": os.path.basename(dump_path) if dump_path else None,
+        "flight_ring_events": [n for n in ring_names
+                               if n.startswith("serve.")],
+        "restart_event_replica": (restarted_ev or {}).get("args", {}).get(
+            "replica_id"),
+    }
+    front.stop(drain=True, timeout=60.0)
+    recorder.uninstall()
+    check(len(errors) == 0,
+          f"fleet kill: {len(errors)} in-flight request failure(s): "
+          f"{errors[:3]}")
+    check(completed[0] > 50, "fleet kill: almost no traffic completed")
+    check(front.handles[0].restarts >= 1, "fleet kill: replica not restarted")
+    check(step["worker_died"] >= 1, "fleet kill: no serve.worker.died counter")
+    check(step["worker_restarted"] >= 1,
+          "fleet kill: no serve.worker.restarted counter")
+    check("serve.worker.restarted" in step["flight_ring_events"],
+          "fleet kill: flight dump missing serve.worker.restarted event")
+    check(step["restart_event_replica"] == 0,
+          "fleet kill: restart event does not name replica 0")
+    record["steps"]["fleet_kill"] = step
 
     record["problems"] = problems
     with open(args.out + ".tmp", "w") as f:

@@ -20,6 +20,14 @@ but on the latency axes that matter for serving:
   p99 latency           new <= old * (1 + tol)   (the latency band)
   retraces_after_warmup must stay 0
 
+Fleet gate: schema "serve_fleet" artifacts (schema_version 2,
+`serve_bench.py --fleet`) are a different workload — N replica processes
+— so they are compared ONLY against predecessors with the same metric
+AND the same replica count (a 4-replica number vs a 2-replica number is
+not a regression signal), on req/s floor, p99 ceiling, and zero
+fleet-wide retraces. Single-process serve artifacts skip fleet records
+cleanly (and vice versa), so the schema bump never breaks the gate.
+
 GOSS gate: the newest ABLATION_r*.json holding both a `goss` arm and a
 both-off baseline arm (`part`, else `b256`/`nopart`) is checked WITHIN
 the artifact — the headline ships with GOSS on, so a previous-BENCH
@@ -175,6 +183,84 @@ def serve_comparable_pair(artifacts: List[Tuple[int, str]]):
     return None
 
 
+def read_fleet_record(path: str) -> dict:
+    """Normalize a serve_fleet artifact (raw or CI-driver-wrapped);
+    {} for anything else (incl. pre-fleet serve_latency records)."""
+    import json
+
+    with open(path) as f:
+        rec = json.load(f)
+    if "parsed" in rec and "cmd" in rec:  # CI driver wrapper
+        rec = rec["parsed"] or {}
+    if rec.get("schema") != "serve_fleet":
+        return {}
+    return {
+        "metric": rec.get("metric"),
+        "replicas": rec.get("replicas"),
+        "req_per_sec": rec.get("value"),
+        "p99_ms": rec.get("p99_ms"),
+        "retraces": rec.get("retraces_fleet"),
+        "raw": rec,
+    }
+
+
+def fleet_comparable_pair(artifacts: List[Tuple[int, str]]):
+    """Newest two fleet records sharing (metric, replica count) — a fleet
+    number is only comparable at the same fan-out."""
+    usable = []
+    for rnd, path in artifacts:
+        try:
+            rec = read_fleet_record(path)
+        except Exception as e:  # noqa: BLE001 — a rotten artifact is a skip
+            print(f"  [skip] {os.path.basename(path)}: unreadable ({e})")
+            continue
+        if rec.get("metric") and rec.get("req_per_sec") is not None:
+            usable.append((rnd, path, rec))
+    if len(usable) < 2:
+        return None
+    newest = usable[-1]
+    for older in reversed(usable[:-1]):
+        if (older[2]["metric"] == newest[2]["metric"]
+                and older[2]["replicas"] == newest[2]["replicas"]):
+            return older, newest
+    return None
+
+
+def check_fleet(old, new, tol: float) -> List[str]:
+    """-> failure messages for the fleet pair (same replica count)."""
+    (o_rnd, _o_path, o), (n_rnd, _n_path, n) = old, new
+    fails = []
+    floor = o["req_per_sec"] * (1.0 - tol)
+    print(
+        f"  fleet req/s ({n['replicas']} replicas): r{n_rnd} "
+        f"{n['req_per_sec']:.1f} vs r{o_rnd} {o['req_per_sec']:.1f} "
+        f"(floor {floor:.1f}, tol {tol:.0%})"
+    )
+    if n["req_per_sec"] < floor:
+        fails.append(
+            f"fleet throughput regressed: {n['req_per_sec']:.1f} < "
+            f"{o['req_per_sec']:.1f} * (1 - {tol}) = {floor:.1f} "
+            f"at {n['replicas']} replicas"
+        )
+    if o.get("p99_ms") is not None and n.get("p99_ms") is not None:
+        ceil = o["p99_ms"] * (1.0 + tol)
+        print(
+            f"  fleet p99: r{n_rnd} {n['p99_ms']:.3f} ms vs r{o_rnd} "
+            f"{o['p99_ms']:.3f} ms (ceiling {ceil:.3f})"
+        )
+        if n["p99_ms"] > ceil:
+            fails.append(
+                f"fleet p99 latency regressed: {n['p99_ms']:.3f} ms > "
+                f"{o['p99_ms']:.3f} * (1 + {tol}) = {ceil:.3f} ms"
+            )
+    if n.get("retraces"):
+        fails.append(
+            f"fleet steady-state retraces: {n['retraces']} "
+            "(a replica's ladder is leaking shapes — see health.retrace)"
+        )
+    return fails
+
+
 def check_serve(old, new, tol: float) -> List[str]:
     """-> failure messages for the serve (latency-schema) pair."""
     (o_rnd, _o_path, o), (n_rnd, _n_path, n) = old, new
@@ -321,6 +407,13 @@ def main(argv=None) -> int:
               "comparable artifacts)")
     else:
         fails += check_serve(*serve_pair, tol=args.tol)
+
+    fleet_pair = fleet_comparable_pair(serve_artifacts)
+    if fleet_pair is None:
+        print("check_bench_regress: SKIP fleet gate (fewer than two "
+              "same-replica-count fleet artifacts)")
+    else:
+        fails += check_fleet(*fleet_pair, tol=args.tol)
 
     # GOSS gate: newest ablation artifact with goss + baseline arms
     ablations = find_ablation_artifacts(args.dir)

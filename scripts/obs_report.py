@@ -16,6 +16,13 @@ Input kind is sniffed, not flagged:
   JSONL stream  first line is the {"type": "meta"} record
   bench JSON    has "metric"/"value" (optionally under the CI driver
                 wrapper's "parsed")
+  fleet metrics a FleetFront /metrics snapshot ("fleet" + "replicas"
+                keys) — rendered as a per-replica fleet table
+
+Fleet postmortems: any artifact whose counters/events carry
+serve.worker.* / serve.front.* evidence gets a "serving fleet" section,
+and events stamped with a replica identity (obs.set_identity) name the
+replica inline.
 """
 
 from __future__ import annotations
@@ -86,6 +93,16 @@ def _load(path: str) -> Tuple[str, dict]:
             "gauges": {},
             "flight": None,
             "bench": None,
+        }
+    if "fleet" in doc and "replicas" in doc and "metric" not in doc:
+        # a FleetFront /metrics snapshot saved to a file
+        return "fleet-metrics", {
+            "events": [],
+            "counters": doc.get("counters") or {},
+            "gauges": doc.get("gauges") or {},
+            "flight": None,
+            "bench": None,
+            "fleet_metrics": doc,
         }
     rec = doc.get("parsed") if ("parsed" in doc and "cmd" in doc) else doc
     rec = rec or {}
@@ -166,6 +183,71 @@ def report(path: str) -> None:
         for k in ("auc", "logloss", "trees", "data_source", "quality_band"):
             if k in bench:
                 print(f"  {k}: {bench[k]}")
+        if bench.get("schema") == "serve_fleet":
+            _section("fleet scaling (sustained req/s at p99)")
+            print(f"  {'replicas':>8s} {'req/s':>10s} {'p50 ms':>9s} "
+                  f"{'p99 ms':>9s} {'retraces':>9s}")
+            for row in bench.get("scaling") or []:
+                print(
+                    f"  {row.get('replicas', '?'):>8} "
+                    f"{row.get('req_per_sec', 0):>10.1f} "
+                    f"{row.get('p50_ms', 0):>9.2f} "
+                    f"{row.get('p99_ms', 0):>9.2f} "
+                    f"{row.get('retraces', 0):>9.0f}"
+                )
+            hot = bench.get("hot_cache")
+            if hot:
+                print(
+                    f"  hot-cache: {hot.get('req_per_sec', 0):.1f} req/s "
+                    f"p99={hot.get('p99_ms', 0):.2f} ms "
+                    f"hit_rate={hot.get('hit_rate', 0):.3f}"
+                )
+            mixed = bench.get("mixed_traffic")
+            if mixed:
+                print(
+                    f"  mixed: requests={mixed.get('requests')} "
+                    f"shed={mixed.get('shed_429')} "
+                    f"failures={mixed.get('failures')} "
+                    f"versions={mixed.get('versions_seen')} "
+                    f"reloads={mixed.get('reloads_fleet')}"
+                )
+
+    fm = data.get("fleet_metrics")
+    if fm:
+        fl = fm.get("fleet") or {}
+        _section("serving fleet")
+        print(f"  replicas: {fl.get('replicas')} ready: {fl.get('ready')} "
+              f"restarts: {fl.get('restarts')}")
+        front_lat = fm.get("latency") or {}
+        fleet_lat = fm.get("fleet_latency") or {}
+        if front_lat.get("count"):
+            print(f"  front latency:  p50={front_lat.get('p50_ms')} "
+                  f"p99={front_lat.get('p99_ms')} ms "
+                  f"(n={front_lat.get('count')})")
+        if fleet_lat.get("count"):
+            print(f"  fleet latency (ring union): "
+                  f"p50={fleet_lat.get('p50_ms')} "
+                  f"p99={fleet_lat.get('p99_ms')} ms "
+                  f"(n={fleet_lat.get('count')})")
+        print(f"  {'id':>4s} {'pid':>8s} {'state':>9s} {'restarts':>8s} "
+              f"{'queued':>7s} {'p99 ms':>8s} {'requests':>9s} "
+              f"{'retrace':>8s}")
+        for rid, info in sorted(
+            fm.get("replicas", {}).items(),
+            key=lambda kv: (int(kv[0]) if kv[0].isdigit() else 1 << 30,
+                            kv[0]),
+        ):
+            lat = info.get("latency") or {}
+            counters = info.get("counters") or {}
+            print(
+                f"  {rid:>4s} {str(info.get('pid')):>8s} "
+                f"{str(info.get('state')):>9s} "
+                f"{info.get('restarts', 0):>8} "
+                f"{info.get('queued_rows', 0):>7} "
+                f"{str(lat.get('p99_ms', '-')):>8s} "
+                f"{counters.get('serve.requests', 0):>9.0f} "
+                f"{counters.get('health.retrace', 0):>8.0f}"
+            )
 
     phases = _phase_table(events)
     if phases or _prefixed(gauges, "gbdt.stat."):
@@ -221,6 +303,32 @@ def report(path: str) -> None:
                 f"{k}={args[k]}"
                 for k in ("version", "from_version", "to_version", "model",
                           "candidate_loss", "incumbent_loss", "reasons")
+                if k in args
+            )
+            print(f"  event {e['name']} @ {e.get('ts', 0):.3f}s {detail}")
+
+    fleet_c = {
+        k: v for k, v in counters.items()
+        if k.startswith(("serve.worker", "serve.front", "serve.fleet",
+                         "serve.aimd", "serve.cache"))
+    }
+    fleet_ev = [
+        e for e in events
+        if str(e.get("name", "")).startswith(("serve.worker", "serve.front",
+                                              "serve.fleet", "serve.aimd"))
+    ]
+    if fleet_c or fleet_ev:
+        _section("serving fleet (replica lifecycle / AIMD / cache)")
+        for k, v in sorted(fleet_c.items()):
+            print(f"  {k:<40s} {v:g}")
+        # the lifecycle trail, newest last — each event names its replica
+        for e in fleet_ev[-12:]:
+            args = e.get("args", {})
+            detail = " ".join(
+                f"{k}={args[k]}"
+                for k in ("replica_id", "from_replica", "to_replica", "pid",
+                          "port", "restarts", "rc", "rows", "from_batch",
+                          "to_batch", "worst_ms", "cause", "error")
                 if k in args
             )
             print(f"  event {e['name']} @ {e.get('ts', 0):.3f}s {detail}")

@@ -22,7 +22,34 @@ Acceptance (ISSUE 4): speedup >= SERVE_BENCH_MIN_SPEEDUP (default 10) and
 scores bit-identical and no steady-state retrace — failures exit non-zero
 AFTER the JSON line is printed (the bench.py artifact discipline).
 
+Fleet mode (`--fleet`, ISSUE 10): the scenario matrix for the multi-
+process serving fleet (docs/serving.md):
+
+  scaling    sustained req/s AT p99 <= --slo-ms across 1..N replicas —
+             each run boots a FleetFront over real `cli serve` worker
+             processes and drives the front's submit path (the /predict
+             hot path minus client HTTP framing, same discipline as the
+             single-process bench), cache OFF so the number is pure
+             scoring fan-out; fleet-wide steady-state retraces must be 0
+  hot-cache  the max-replica run again with the prediction cache armed
+             and a re-visiting request stream — Clipper's hot-query
+             layer, reported separately (hit rate included) so the
+             headline stays an honest cold number
+  mixed      hot-reload + overload shed mid-load: a model re-dump lands
+             while traffic flows (workers warm-then-swap, one version per
+             batch) and a burst beyond the queue bound must shed typed
+             429s, with zero non-shed failures
+
+Emits one `schema: "serve_fleet"` (schema_version 2) JSON line;
+--record writes SERVE_rNN.json for check_bench_regress's fleet gate
+(fleet records only compare against same-replica-count predecessors).
+
+Acceptance: headline (max replicas) >= SERVE_FLEET_MIN_X (2.5) x the
+SERVE_r09 single-process baseline, p99 <= SLO, zero fleet retraces,
+mixed scenario completes with sheds > 0 and both model versions seen.
+
 Usage: python scripts/serve_bench.py [--seconds 2.0] [--record SERVE_rNN.json]
+       python scripts/serve_bench.py --fleet --replicas 4 --record SERVE_r14.json
 """
 
 from __future__ import annotations
@@ -187,6 +214,328 @@ def bench_serve(scorer, rows, seconds: float, window: int = 512):
     return n / (time.perf_counter() - t0), latencies
 
 
+# ---------------------------------------------------------------------------
+# Fleet scenario matrix (--fleet): scaling 1..N replicas, hot-cache, mixed
+# ---------------------------------------------------------------------------
+
+
+def _write_serve_conf(tmp_dir: str, trees: int) -> str:
+    conf_path = os.path.join(tmp_dir, "serve.conf")
+    with open(conf_path, "w") as f:
+        json.dump({
+            "model": {"data_path": os.path.join(tmp_dir, "gbdt.model")},
+            "optimization": {"loss_function": "sigmoid",
+                             "round_num": trees},
+        }, f)
+    return conf_path
+
+
+def _boot_front(conf_path, replicas, slo_ms, cache_rows, watch_s,
+                front_queue):
+    from ytklearn_tpu.serve import BatchPolicy, FleetFront, serve_worker_argv
+
+    flags = [
+        "--watch-interval", str(watch_s),
+        "--slo-ms", str(slo_ms),
+        "--cache-rows", str(cache_rows),
+        "--max-queue", "16384",
+        "--max-batch", "512",
+    ]
+    front = FleetFront(
+        serve_worker_argv(conf_path, "gbdt", flags),
+        replicas,
+        policy=BatchPolicy(max_batch=512, max_wait_ms=0.5,
+                           max_queue=front_queue),
+        ready_timeout_s=600.0,
+    )
+    return front.start()
+
+
+def drive_front(front, rows, seconds: float, window: int, row_picker=None):
+    """Bounded-in-flight single-row driver against front.submit ->
+    (req/s, latency list ms) — the /predict hot path minus client HTTP."""
+    if row_picker is None:
+        def row_picker(i):
+            return rows[i % len(rows)]
+
+    inflight = collections.deque()
+    latencies = []
+    n, i = 0, 0
+    t0 = time.perf_counter()
+    end = t0 + seconds
+    while True:
+        now = time.perf_counter()
+        if now >= end and not inflight:
+            break
+        if now < end and len(inflight) < window:
+            inflight.append((front.submit([row_picker(i)]),
+                             time.perf_counter()))
+            i += 1
+            continue
+        pending, t_sub = inflight.popleft()
+        pending.get(timeout=300.0)
+        latencies.append((time.perf_counter() - t_sub) * 1e3)
+        n += 1
+    return n / (time.perf_counter() - t0), latencies
+
+
+def _fleet_counters(front):
+    """Scrape every replica's /metrics -> (aggregated counters, per-id)."""
+    from ytklearn_tpu.serve.fleet import http_json
+
+    keys = ("health.retrace", "serve.reload", "serve.cache.hit",
+            "serve.cache.miss", "serve.cache.evict", "serve.shed",
+            "serve.batches", "serve.batch_rows")
+    agg = {k: 0.0 for k in keys}
+    per = {}
+    for rid, h in sorted(front.handles.items()):
+        try:
+            status, m = http_json("GET", h.port, "/metrics", timeout=15.0)
+        except OSError:
+            per[str(rid)] = {"scrape_failed": True}
+            continue
+        c = (m.get("counters") or {}) if status == 200 else {}
+        per[str(rid)] = {k: c.get(k, 0.0) for k in keys}
+        per[str(rid)]["pid"] = (m.get("replica") or {}).get("pid")
+        per[str(rid)]["batching"] = m.get("batching")
+        for k in keys:
+            agg[k] += c.get(k, 0.0)
+    return agg, per
+
+
+def _lat_stats(latencies):
+    lat = np.asarray(latencies) if latencies else np.asarray([0.0])
+    return (round(float(np.percentile(lat, 50)), 3),
+            round(float(np.percentile(lat, 99)), 3))
+
+
+def fleet_mixed(conf_path, tmp_dir, replicas, slo_ms, rows, seconds, log):
+    """Hot-reload + overload shed mid-load: returns the scenario record."""
+    from ytklearn_tpu.serve.batcher import OverloadError
+
+    model_path = os.path.join(tmp_dir, "gbdt.model")
+    # small front queue so the burst provably sheds
+    front = _boot_front(conf_path, replicas, slo_ms, cache_rows=0,
+                        watch_s=0.5, front_queue=512)
+    versions = collections.Counter()
+    sheds = 0
+    failures = []
+    inflight = collections.deque()
+    window = 256 * replicas
+    n = i = 0
+    try:
+        t0 = time.perf_counter()
+        end = t0 + seconds
+        reload_t, burst_t = t0 + seconds * 0.25, t0 + seconds * 0.6
+        reload_done = burst_done = False
+        while True:
+            now = time.perf_counter()
+            if now >= end and not inflight:
+                break
+            if not reload_done and now >= reload_t:
+                # re-dump lands mid-traffic: mtime bump + version sidecar
+                # -> every worker's watcher warms the new scorer off to
+                # the side and swaps (one version per batch throughout)
+                os.utime(model_path)
+                with open(model_path + ".version.json", "w") as f:
+                    json.dump({"version": 2}, f)
+                reload_done = True
+                log.info("fleet mixed: model re-dump landed")
+                continue
+            if not burst_done and now >= burst_t:
+                # overload burst: far past the front queue bound in one go
+                burst = 0
+                for k in range(4096):
+                    try:
+                        inflight.append(
+                            (front.submit([rows[(i + k) % len(rows)]]),
+                             time.perf_counter()))
+                        burst += 1
+                    except OverloadError:
+                        sheds += 1
+                i += burst
+                burst_done = True
+                log.info("fleet mixed: burst enqueued=%d shed=%d",
+                         burst, sheds)
+                continue
+            if now < end and len(inflight) < window:
+                try:
+                    inflight.append(
+                        (front.submit([rows[i % len(rows)]]),
+                         time.perf_counter()))
+                    i += 1
+                except OverloadError:
+                    sheds += 1
+                continue
+            pending, _ts = inflight.popleft()
+            try:
+                pending.get(timeout=300.0)
+                meta = pending.meta or {}
+                versions[meta.get("version")] += 1
+                n += 1
+            except Exception as e:  # noqa: BLE001 — a failed request is the finding
+                failures.append(f"{type(e).__name__}: {e}"[:200])
+        agg, per = _fleet_counters(front)
+    finally:
+        front.stop(drain=True, timeout=60.0)
+    return {
+        "completed": True,
+        "requests": n,
+        "shed_429": sheds,
+        "failures": len(failures),
+        "failure_samples": failures[:3],
+        "versions_seen": sorted(int(v) for v in versions if v is not None),
+        "responses_per_version": {str(k): v for k, v in sorted(
+            versions.items(), key=lambda kv: str(kv[0]))},
+        "reloads_fleet": agg["serve.reload"],
+        "retraces_fleet": agg["health.retrace"],
+    }
+
+
+def fleet_main(args, log) -> int:
+    # env WRITE so spawned replica workers inherit obs collection (their
+    # /metrics counters are the bench's evidence); not a knob read
+    os.environ.setdefault("YTK_OBS", "1")  # ytklint: allow(undeclared-knob) reason=env write for child worker processes, read stays in knobs.py
+    import tempfile
+
+    from ytklearn_tpu import obs
+
+    if knobs.get_raw("YTK_OBS") != "0":
+        obs.configure(enabled=True)
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r9 = None
+    try:
+        with open(os.path.join(repo, "SERVE_r09.json")) as f:
+            r9 = float(json.load(f).get("value"))
+    except (OSError, ValueError, TypeError):
+        log.warning("no SERVE_r09.json baseline; fleet floor check skipped")
+
+    with tempfile.TemporaryDirectory() as tmp_dir:
+        pred, _names, gen_rows, source = _build_model(tmp_dir)
+        trees = len(pred.model.trees)
+        conf_path = _write_serve_conf(tmp_dir, trees)
+        rng = np.random.RandomState(7)
+        rows = gen_rows(rng, args.requests)
+        # pre-serialized row fragments: the front's raw-splice forward
+        # path (what an HTTP gateway holds as raw request bytes anyway)
+        frags = [json.dumps(r) for r in rows]
+        log.info("fleet bench: model=%s trees=%d replicas up to %d",
+                 source, trees, args.replicas)
+
+        scaling = []
+        for n_rep in range(1, args.replicas + 1):
+            window = args.window * n_rep
+            front = _boot_front(conf_path, n_rep, args.slo_ms, 0, 0,
+                                front_queue=window * 4)
+            try:
+                drive_front(front, frags, 1.0, window)  # settle AIMD first
+                qps, lat = drive_front(front, frags, args.seconds, window)
+                agg, per = _fleet_counters(front)
+            finally:
+                front.stop(drain=True, timeout=60.0)
+            p50, p99 = _lat_stats(lat)
+            rec = {"replicas": n_rep, "req_per_sec": round(qps, 1),
+                   "p50_ms": p50, "p99_ms": p99, "window": window,
+                   "retraces": agg["health.retrace"],
+                   "batches": agg["serve.batches"]}
+            scaling.append(rec)
+            log.info("fleet scaling: %d replica(s) %.0f req/s p99=%.1fms "
+                     "retraces=%.0f", n_rep, qps, p99, agg["health.retrace"])
+
+        headline = scaling[-1]
+
+        # hot-cache scenario: same fleet, prediction cache armed, the same
+        # request pool re-visited — Clipper's hot-query layer
+        front = _boot_front(conf_path, args.replicas, args.slo_ms,
+                            args.hot_cache_rows, 0,
+                            front_queue=args.window * args.replicas * 4)
+        try:
+            window = args.window * args.replicas
+            drive_front(front, frags, 1.0, window)
+            qps, lat = drive_front(front, frags, args.seconds, window)
+            agg, _per = _fleet_counters(front)
+        finally:
+            front.stop(drain=True, timeout=60.0)
+        p50, p99 = _lat_stats(lat)
+        hits, misses = agg["serve.cache.hit"], agg["serve.cache.miss"]
+        hot = {"replicas": args.replicas, "req_per_sec": round(qps, 1),
+               "p50_ms": p50, "p99_ms": p99,
+               "cache_rows": args.hot_cache_rows,
+               "hit_rate": round(hits / max(hits + misses, 1.0), 4),
+               "evictions": agg["serve.cache.evict"],
+               "retraces": agg["health.retrace"]}
+        log.info("fleet hot-cache: %.0f req/s p99=%.1fms hit_rate=%.2f",
+                 qps, p99, hot["hit_rate"])
+
+        mixed = fleet_mixed(conf_path, tmp_dir, args.replicas, args.slo_ms,
+                            frags, args.mixed_seconds, log)
+        log.info("fleet mixed: %s", mixed)
+
+    out = {
+        "schema_version": 2,
+        "schema": "serve_fleet",
+        "metric": f"serve_fleet_req_per_sec_{source}_gbdt",
+        "value": headline["req_per_sec"],
+        "unit": "req/s",
+        "replicas": args.replicas,
+        "slo_ms": args.slo_ms,
+        "p50_ms": headline["p50_ms"],
+        "p99_ms": headline["p99_ms"],
+        "retraces_fleet": headline["retraces"],
+        "scaling": scaling,
+        "hot_cache": hot,
+        "mixed_traffic": mixed,
+        "baseline": {"artifact": "SERVE_r09.json", "req_per_sec": r9},
+        "speedup_vs_r9_single": (round(headline["req_per_sec"] / r9, 2)
+                                 if r9 else None),
+        "data_source": source,
+        "trees": trees,
+    }
+    print(json.dumps(out), flush=True)
+    if args.record:
+        with open(args.record, "w") as f:
+            json.dump(out, f, indent=1)
+
+    min_x = float(os.environ.get("SERVE_FLEET_MIN_X", "2.5"))
+    fails = []
+    if r9 and headline["req_per_sec"] < min_x * r9:
+        fails.append(
+            f"fleet headline {headline['req_per_sec']:.0f} req/s < "
+            f"{min_x}x r9 baseline ({r9:.0f})"
+        )
+    if headline["p99_ms"] > args.slo_ms:
+        fails.append(
+            f"fleet p99 {headline['p99_ms']:.1f} ms > SLO {args.slo_ms} ms"
+        )
+    for rec in scaling:
+        if rec["retraces"] > 0:
+            fails.append(
+                f"{rec['retraces']:.0f} steady-state retrace(s) at "
+                f"{rec['replicas']} replica(s)"
+            )
+    if mixed["failures"] > 0:
+        fails.append(
+            f"mixed-traffic run had {mixed['failures']} failed request(s): "
+            f"{mixed['failure_samples']}"
+        )
+    if mixed["shed_429"] < 1:
+        fails.append("mixed-traffic burst shed nothing (queue bound inert)")
+    if mixed["versions_seen"] != [1, 2]:
+        fails.append(
+            f"mixed-traffic versions_seen {mixed['versions_seen']} != [1, 2] "
+            "(hot reload did not land mid-load)"
+        )
+    if mixed["retraces_fleet"] > 0:
+        fails.append(
+            f"mixed-traffic run retraced {mixed['retraces_fleet']:.0f}x "
+            "(reload warmup leaked into steady state)"
+        )
+    for msg in fails:
+        log.error("FAIL: %s", msg)
+    return 1 if fails else 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--seconds", type=float,
@@ -195,11 +544,28 @@ def main() -> int:
                     help="distinct request rows cycled through")
     ap.add_argument("--record", default="",
                     help="also write the JSON artifact here (SERVE_rNN.json)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="run the fleet scenario matrix instead of the "
+                    "single-process bench (schema serve_fleet)")
+    ap.add_argument("--replicas", type=int, default=4,
+                    help="fleet size for the scaling matrix (1..N)")
+    ap.add_argument("--slo-ms", type=float, default=100.0,
+                    help="p99 SLO the AIMD controller targets and the "
+                    "acceptance check enforces")
+    ap.add_argument("--window", type=int, default=512,
+                    help="in-flight request window per replica")
+    ap.add_argument("--mixed-seconds", type=float, default=12.0,
+                    help="mixed-traffic (reload + shed) scenario duration")
+    ap.add_argument("--hot-cache-rows", type=int, default=65536,
+                    help="prediction-cache rows for the hot-cache scenario")
     args = ap.parse_args()
 
     logging.basicConfig(level=logging.INFO, stream=sys.stderr,
                         format="%(asctime)s %(name)s %(levelname)s %(message)s")
     log = logging.getLogger("serve_bench")
+
+    if args.fleet:
+        return fleet_main(args, log)
 
     import jax
 

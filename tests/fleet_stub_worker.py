@@ -1,0 +1,124 @@
+"""Stub replica worker for the fleet-front tests.
+
+Speaks the worker contract (banner line with the bound port, /readyz,
+/predict, /metrics?raw=1, /admin/*) without importing jax, so the front's
+spawn/balance/kill/restart machinery is drillable in milliseconds per
+process instead of a jax import + ladder warmup each.
+
+Scoring is a deterministic echo: score(row) = weight * sum(values),
+prediction = score * 2 — the tests recompute it to prove routing and
+rerouting never corrupted or dropped a row.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--replica-id", type=int, default=-1)
+    ap.add_argument("--weight", type=float, default=1.0)
+    ap.add_argument("--delay-ms", type=float, default=0.0,
+                    help="per-/predict sleep (slow-replica scenarios)")
+    ap.add_argument("--version", type=int, default=1)
+    ap.add_argument("--start-delay-ms", type=float, default=0.0,
+                    help="sleep before binding (restart-timing scenarios)")
+    args, _unknown = ap.parse_known_args()
+
+    if args.start_delay_ms > 0:
+        time.sleep(args.start_delay_ms / 1e3)
+
+    state = {"requests": 0, "latencies": []}
+    lock = threading.Lock()
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *a):
+            pass
+
+        def _json(self, code, payload):
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):  # noqa: N802
+            path = urllib.parse.urlsplit(self.path).path
+            if path == "/readyz":
+                self._json(200, {"ready": True, "status": "ok"})
+            elif path == "/metrics":
+                with lock:
+                    lats = list(state["latencies"])
+                    n = state["requests"]
+                self._json(200, {
+                    "replica": {"replica_id": args.replica_id,
+                                "pid": os.getpid()},
+                    "latency": {"count": len(lats), "raw_ms": lats},
+                    "queue_depth": {"default": 0},
+                    "batching": {"default": {"max_batch": 64,
+                                             "max_wait_ms": 1.0}},
+                    "counters": {"serve.requests": n,
+                                 "health.retrace": 0},
+                    "gauges": {},
+                })
+            elif path == "/healthz":
+                self._json(200, {"status": "ok"})
+            else:
+                self._json(404, {"error": "unknown path"})
+
+        def do_POST(self):  # noqa: N802
+            n = int(self.headers.get("Content-Length", 0))
+            req = json.loads(self.rfile.read(n) or b"{}")
+            if self.path.startswith("/admin/"):
+                self._json(200, {"model": req.get("model") or "default",
+                                 "action": self.path.rsplit("/", 1)[1],
+                                 "pinned": True,
+                                 "replica_id": args.replica_id})
+                return
+            if self.path != "/predict":
+                self._json(404, {"error": "unknown path"})
+                return
+            rows = req.get("rows") or [req.get("features") or {}]
+            if req.get("model") not in (None, "default"):
+                self._json(404, {"error": f"no model named "
+                                          f"{req['model']!r} is loaded",
+                                 "type": "unknown_model"})
+                return
+            if args.delay_ms > 0:
+                time.sleep(args.delay_ms / 1e3)
+            scores = [args.weight * sum(r.values()) for r in rows]
+            with lock:
+                state["requests"] += 1
+                state["latencies"].append(round(args.delay_ms + 1.0, 3))
+            self._json(200, {
+                "model": "default",
+                "version": args.version,
+                "replica_stub": args.replica_id,
+                "scores": scores,
+                "predictions": [s * 2.0 for s in scores],
+            })
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    print(json.dumps({"port": httpd.server_address[1],
+                      "pid": os.getpid(),
+                      "replica_id": args.replica_id}), flush=True)
+    try:
+        httpd.serve_forever(poll_interval=0.1)
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

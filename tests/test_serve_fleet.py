@@ -1,0 +1,506 @@
+"""Serving fleet: AIMD batch sizing, prediction cache, multi-replica front.
+
+Front process-management tests spawn tests/fleet_stub_worker.py (the
+worker HTTP contract without a jax import) so kill -9 / restart drills
+cost milliseconds per replica; one end-to-end test boots the real thing
+(`cli serve --replicas 2`) and proves the full stack over HTTP.
+"""
+
+import json
+import os
+import signal
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from serve_models import build_linear
+from ytklearn_tpu import obs
+from ytklearn_tpu.serve import (
+    AIMDController,
+    BatchPolicy,
+    FleetFront,
+    MicroBatcher,
+    ModelRegistry,
+    PredictionCache,
+    ServeApp,
+)
+from ytklearn_tpu.serve.fleet.cache import row_key
+
+STUB = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                    "fleet_stub_worker.py")
+LADDER = (1, 4, 16)
+
+
+@pytest.fixture()
+def obs_on():
+    obs.configure(enabled=True)
+    obs.reset()
+    yield
+    obs.configure(enabled=False)
+    obs.reset()
+
+
+# ---------------------------------------------------------------------------
+# AIMD controller
+# ---------------------------------------------------------------------------
+
+
+def test_aimd_snaps_to_ladder_and_climbs():
+    c = AIMDController((1, 8, 64), slo_ms=50.0, inc=8, backoff=0.5, window=2)
+    assert c.max_batch in (1, 8, 64)
+    seen = set()
+    for _ in range(40):
+        c.observe(5.0)  # well under the SLO
+        c.note_batch()
+        seen.add(c.max_batch)
+        assert c.max_batch in (1, 8, 64)  # every cap is a compiled rung
+    assert c.max_batch == 64  # clean windows climb to the top rung
+
+
+def test_aimd_multiplicative_backoff_on_injected_violations():
+    c = AIMDController((1, 8, 64, 512), slo_ms=20.0, inc=8, backoff=0.5,
+                       window=1)
+    c._raw = 512.0
+    c.max_batch = c._snap(c._raw)
+    assert c.max_batch == 512
+    caps = []
+    for _ in range(4):
+        c.observe(90.0)  # injected SLO violation
+        c.note_batch()
+        caps.append(c.max_batch)
+    # raw halves each violating window: 256, 128, 64, 32 -> snapped down
+    assert caps == [64, 64, 64, 8]
+    assert c._raw == pytest.approx(32.0)
+
+
+def test_aimd_converges_to_the_knee_rung():
+    """Synthetic latency model lat = 2ms/row * batch: 8 rows meet a 30ms
+    SLO, 64 rows blow it — AIMD must live at 8, and every excursion to 64
+    must be knocked back within one window."""
+    c = AIMDController((1, 8, 64), slo_ms=30.0, inc=8, backoff=0.5, window=1)
+    history = []
+    for _ in range(200):
+        c.observe(2.0 * c.max_batch)
+        c.note_batch()
+        history.append(c.max_batch)
+    tail = history[-50:]
+    assert tail.count(8) >= 40  # converged (periodic one-window 64 probes)
+    assert 64 not in set(tail[i] for i in range(1, len(tail))
+                         if tail[i - 1] == 64)  # never two windows at 64
+
+
+def test_aimd_through_batcher_with_slow_scorer(obs_on):
+    """End to end through the MicroBatcher: a scorer whose latency grows
+    with batch size forces backoff; the cap stays on the ladder and the
+    obs evidence (serve.aimd.*) lands."""
+    ladder = (1, 8, 32)
+    c = AIMDController(ladder, slo_ms=25.0, inc=8, backoff=0.5, window=2)
+    batch_sizes = []
+
+    def score_fn(rows):
+        batch_sizes.append(len(rows))
+        time.sleep(0.002 * len(rows))  # 2ms per row
+        vals = np.asarray([r["x"] for r in rows])
+        return vals, vals
+
+    b = MicroBatcher(score_fn, BatchPolicy(max_queue=4096), controller=c)
+    try:
+        pendings = []
+        for i in range(400):
+            pendings.append(b.submit([{"x": float(i)}]))
+            if len(pendings) >= 64:
+                pendings.pop(0).get(timeout=30.0)
+        for p in pendings:
+            p.get(timeout=30.0)
+    finally:
+        b.close(drain=True)
+    assert max(batch_sizes) <= 32
+    snap = obs.snapshot()["counters"]
+    assert snap.get("serve.aimd.backoff", 0) >= 1  # 32-row batches violate
+    assert c.max_batch in ladder
+    assert c.max_batch <= 8  # 32 rows = 64ms >> SLO; 8 rows = 16ms fits
+
+
+# ---------------------------------------------------------------------------
+# prediction cache
+# ---------------------------------------------------------------------------
+
+
+def _linear_app(tmp_path, cache_rows, weight=1.0, watch=0):
+    path = tmp_path / "hot.model"
+    path.write_text(f"c0,{weight:.6f},1.0\n_bias_,0.0\n")
+    cfg = {"model": {"data_path": str(path)},
+           "loss": {"loss_function": "sigmoid"}}
+    reg = ModelRegistry(ladder=LADDER, watch_interval_s=watch)
+    reg.load("default", "linear", cfg)
+    app = ServeApp(reg, BatchPolicy(max_batch=16, max_wait_ms=0.5),
+                   cache_rows=cache_rows)
+    return app, reg, path
+
+
+def test_cache_hit_bit_identical_and_bypasses_queue(tmp_path, obs_on):
+    app, reg, _ = _linear_app(tmp_path, cache_rows=64)
+    rows = [{"c0": 1.25}, {"c0": -3.5}]
+    try:
+        cold = app.predict(rows, timeout=10.0)
+        assert "cached" not in cold
+        batches_before = obs.snapshot()["counters"].get("serve.batches", 0)
+        hot = app.predict(rows, timeout=10.0)
+        assert hot.get("cached") is True
+        # bit-identical to the scored path, not approximately equal
+        assert hot["scores"] == cold["scores"]
+        assert hot["predictions"] == cold["predictions"]
+        assert hot["version"] == cold["version"]
+        # the hit never touched the batcher
+        assert obs.snapshot()["counters"].get("serve.batches", 0) == batches_before
+        c = obs.snapshot()["counters"]
+        assert c.get("serve.cache.hit", 0) == len(rows)
+        assert c.get("serve.cache.miss", 0) >= 1
+    finally:
+        for b in app._batchers.values():
+            b.close(drain=True)
+        reg.close()
+
+
+def test_cache_partial_hit_rides_scored_path(tmp_path):
+    app, reg, _ = _linear_app(tmp_path, cache_rows=64)
+    try:
+        app.predict([{"c0": 1.0}], timeout=10.0)
+        out = app.predict([{"c0": 1.0}, {"c0": 2.0}], timeout=10.0)
+        # one known row + one new row: the whole request is scored (one
+        # model version end to end), and now both rows are cached
+        assert "cached" not in out
+        again = app.predict([{"c0": 2.0}, {"c0": 1.0}], timeout=10.0)
+        assert again.get("cached") is True
+        assert again["scores"] == [out["scores"][1], out["scores"][0]]
+    finally:
+        for b in app._batchers.values():
+            b.close(drain=True)
+        reg.close()
+
+
+def test_cache_lru_bound_and_evict_counter(obs_on):
+    cache = PredictionCache(4)
+
+    class _E:
+        fingerprint = "fp"
+        version = 1
+
+    mk = cache.model_key(_E)
+    for i in range(10):
+        cache.store(mk, [{"c0": float(i)}], np.array([float(i)]),
+                    np.array([2.0 * i]))
+    assert len(cache) == 4
+    c = obs.snapshot()["counters"]
+    assert c.get("serve.cache.evict", 0) == 6
+    # oldest rows are gone, newest survive
+    assert cache.lookup(mk, [{"c0": 0.0}]) is None
+    assert cache.lookup(mk, [{"c0": 9.0}]) is not None
+    # lookups refresh recency: touching row 6 must keep it over row 7
+    assert cache.lookup(mk, [{"c0": 6.0}]) is not None
+    cache.store(mk, [{"c0": 99.0}], np.array([99.0]), np.array([198.0]))
+    assert cache.lookup(mk, [{"c0": 6.0}]) is not None
+    assert cache.lookup(mk, [{"c0": 7.0}]) is None
+
+
+def test_cache_row_key_canonicalizes_order():
+    assert row_key({"a": 1.0, "b": 2.0}) == row_key({"b": 2.0, "a": 1.0})
+    assert row_key({"a": 1.0}) != row_key({"a": 2.0})
+
+
+def test_cache_invalidated_on_hot_reload(tmp_path):
+    app, reg, path = _linear_app(tmp_path, cache_rows=64, weight=1.0)
+    row = {"c0": 2.0}
+    try:
+        out1 = app.predict([row], timeout=10.0)
+        assert out1["scores"][0] == 2.0 and out1["version"] == 1
+        hot = app.predict([row], timeout=10.0)
+        assert hot.get("cached") is True
+        time.sleep(0.01)  # mtime tick for the fingerprint
+        path.write_text("c0,3.000000,1.0\n_bias_,0.0\n")
+        assert reg.maybe_reload("default") is True
+        # same row, new model: the old cache entry's fingerprint key no
+        # longer matches, so this MUST be scored fresh (w=3 -> 6.0)
+        out2 = app.predict([row], timeout=10.0)
+        assert "cached" not in out2
+        assert out2["scores"][0] == 6.0 and out2["version"] == 2
+        hot2 = app.predict([row], timeout=10.0)
+        assert hot2.get("cached") is True and hot2["scores"][0] == 6.0
+    finally:
+        for b in app._batchers.values():
+            b.close(drain=True)
+        reg.close()
+
+
+# ---------------------------------------------------------------------------
+# fleet front over stub workers (process management without jax startup)
+# ---------------------------------------------------------------------------
+
+
+def _stub_front(replicas=2, stub_flags=(), **kw):
+    kw.setdefault("policy", BatchPolicy(max_batch=64, max_wait_ms=0.5,
+                                        max_queue=4096))
+    kw.setdefault("ready_timeout_s", 30.0)
+    kw.setdefault("monitor_interval_s", 0.1)
+    return FleetFront(
+        [sys.executable, STUB, "--weight", "2.0", *stub_flags],
+        replicas, **kw,
+    )
+
+
+def test_front_routes_scores_and_balances(obs_on):
+    front = _stub_front(replicas=2).start()
+    try:
+        seen_replicas = set()
+        for i in range(40):
+            out = front.predict([{"x": float(i), "y": 1.0}], timeout=15.0)
+            assert out["scores"][0] == pytest.approx(2.0 * (i + 1.0))
+            assert out["predictions"][0] == pytest.approx(4.0 * (i + 1.0))
+            assert out["version"] == 1 and out["model"] == "default"
+            seen_replicas.add(out["replica"])
+        assert seen_replicas <= {0, 1}
+        m = front.metrics_payload()
+        assert m["fleet"]["replicas"] == 2 and m["fleet"]["ready"] == 2
+        # per-replica identity is threaded end to end
+        for rid, info in m["replicas"].items():
+            assert info["replica_id"] == int(rid)
+            assert info["pid"] == front.handles[int(rid)].pid
+        # fleet latency is the UNION of replica rings, not replica-0's
+        ring_total = sum(
+            info.get("latency", {}).get("count", 0)
+            for info in m["replicas"].values()
+        )
+        assert m["fleet_latency"]["count"] == ring_total > 0
+        assert m["latency"]["count"] == 40  # front-side client latency
+    finally:
+        front.stop(drain=True, timeout=15.0)
+
+
+def test_front_kill9_reroutes_with_zero_failures_and_restarts(obs_on):
+    """The fleet acceptance drill in miniature: kill -9 one replica under
+    load; every in-flight request still completes (rerouted), and the
+    slot restarts with serve.worker.{died,restarted} evidence."""
+    front = _stub_front(replicas=2).start()
+    errors, results = [], []
+    stop = threading.Event()
+
+    def hammer(tid):
+        i = 0
+        while not stop.is_set():
+            try:
+                out = front.predict([{"x": float(tid * 1000 + i)}],
+                                    timeout=30.0)
+                assert out["scores"][0] == pytest.approx(
+                    2.0 * (tid * 1000 + i))
+                results.append(out["replica"])
+            except Exception as e:  # noqa: BLE001 — collected for the assert
+                errors.append(e)
+            i += 1
+
+    threads = [threading.Thread(target=hammer, args=(t,)) for t in range(4)]
+    try:
+        for t in threads:
+            t.start()
+        time.sleep(0.3)  # traffic flowing through both replicas
+        victim = front.handles[0]
+        os.kill(victim.pid, signal.SIGKILL)
+        deadline = time.time() + 20.0
+        while time.time() < deadline and not (
+            front.handles[0].restarts >= 1
+            and front.handles[0].state == "ready"
+        ):
+            time.sleep(0.05)
+        time.sleep(0.3)  # traffic over the restarted replica too
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=20.0)
+        try:
+            assert not errors, f"requests failed across the kill: {errors[:3]}"
+            assert front.handles[0].restarts >= 1
+            assert front.handles[0].state == "ready"
+            assert front.handles[0].pid != victim.pid or True  # new process
+            c = obs.snapshot()["counters"]
+            assert c.get("serve.worker.died", 0) >= 1
+            assert c.get("serve.worker.restarted", 0) >= 1
+            ev_names = {e.get("name") for e in obs.REGISTRY.events}
+            assert "serve.worker.restarted" in ev_names
+        finally:
+            front.stop(drain=True, timeout=15.0)
+    assert len(results) > 50
+
+
+def test_front_admin_fans_out_to_every_replica():
+    front = _stub_front(replicas=2).start()
+    try:
+        ok, detail = front.admin("pin")
+        assert ok is True
+        assert sorted(detail) == ["0", "1"]
+        assert all(d["status"] == 200 and d["pinned"] for d in detail.values())
+    finally:
+        front.stop(drain=True, timeout=15.0)
+
+
+def test_front_http_listener_and_unknown_model_404():
+    import urllib.error
+    import urllib.request
+
+    front = _stub_front(replicas=1).start().serve_http()
+
+    def _http(method, path, payload=None):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{front.port}{path}",
+            data=json.dumps(payload).encode() if payload is not None else None,
+            headers={"Content-Type": "application/json"}, method=method,
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=15.0) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    try:
+        code, ready = _http("GET", "/readyz")
+        assert code == 200 and ready["ready"] is True
+        code, out = _http("POST", "/predict", {"features": {"x": 3.0}})
+        assert code == 200 and out["scores"][0] == pytest.approx(6.0)
+        assert out["replica"] == 0
+        code, err = _http("POST", "/predict",
+                          {"features": {"x": 1.0}, "model": "nope"})
+        assert code == 404 and err["type"] == "unknown_model"
+        code, m = _http("GET", "/metrics")
+        assert code == 200 and m["fleet"]["ready"] == 1
+        code, body = _http("POST", "/admin/pin", {})
+        assert code == 200 and body["ok"] is True
+    finally:
+        front.stop(drain=True, timeout=15.0)
+
+
+# ---------------------------------------------------------------------------
+# replica identity in obs + /metrics
+# ---------------------------------------------------------------------------
+
+
+def test_replica_identity_in_metrics_and_obs_events(tmp_path, obs_on):
+    from ytklearn_tpu.obs import core as obs_core
+
+    predictor, _names = build_linear(tmp_path)
+    reg = ModelRegistry(ladder=LADDER, watch_interval_s=0)
+    from test_serve import _load_prebuilt
+
+    _load_prebuilt(reg, "default", predictor)
+    app = ServeApp(reg, BatchPolicy(max_wait_ms=0.5), replica_id=7)
+    try:
+        m = app.metrics_payload()
+        assert m["replica"] == {"replica_id": 7, "pid": os.getpid()}
+        saved = dict(obs_core.IDENTITY)
+        try:
+            obs_core.IDENTITY.clear()
+            obs.set_identity(replica_id=7)
+            obs.event("serve.test_event", detail="x")
+            ev = [e for e in obs.REGISTRY.events
+                  if e.get("name") == "serve.test_event"][-1]
+            assert ev["args"]["replica_id"] == 7
+            assert ev["args"]["detail"] == "x"
+        finally:
+            obs_core.IDENTITY.clear()
+            obs_core.IDENTITY.update(saved)
+    finally:
+        for b in app._batchers.values():
+            b.close(drain=True)
+        reg.close()
+
+
+def test_metrics_raw_ring_export(tmp_path):
+    predictor, _names = build_linear(tmp_path)
+    reg = ModelRegistry(ladder=LADDER, watch_interval_s=0)
+    from test_serve import _load_prebuilt
+
+    _load_prebuilt(reg, "default", predictor)
+    app = ServeApp(reg, BatchPolicy(max_wait_ms=0.5))
+    try:
+        for i in range(3):
+            app.predict([{"c0": float(i)}], timeout=10.0)
+        assert "raw_ms" not in app.metrics_payload()["latency"]
+        raw = app.metrics_payload(raw=True)["latency"]["raw_ms"]
+        assert len(raw) == 3 and all(v >= 0 for v in raw)
+    finally:
+        for b in app._batchers.values():
+            b.close(drain=True)
+        reg.close()
+
+
+# ---------------------------------------------------------------------------
+# the real thing: cli serve --replicas 2, full stack over HTTP
+# ---------------------------------------------------------------------------
+
+
+def test_cli_serve_fleet_subprocess(tmp_path):
+    """Boot a real 2-replica fleet from the CLI (workers are full jax
+    scorers), score through the front, check fleet metrics + admin
+    fan-out, then SIGTERM-drain the whole tree."""
+    import subprocess
+    import urllib.error
+    import urllib.request
+
+    (tmp_path / "cli.model").write_text("c0,2.000000,1.0\n_bias_,0.0\n")
+    conf = tmp_path / "serve.conf"
+    conf.write_text(json.dumps({
+        "model": {"data_path": str(tmp_path / "cli.model")},
+        "loss": {"loss_function": "sigmoid"},
+    }))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ytklearn_tpu.cli", "serve", str(conf),
+         "linear", "--port", "0", "--host", "127.0.0.1",
+         "--replicas", "2", "--ladder", "1,4", "--watch-interval", "0",
+         "--cache-rows", "32"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=env, text=True,
+    )
+
+    def _http(method, port, path, payload=None):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}",
+            data=json.dumps(payload).encode() if payload is not None else None,
+            headers={"Content-Type": "application/json"}, method=method,
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=30.0) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    try:
+        line = proc.stdout.readline()
+        info = json.loads(line)
+        assert info["fleet"] is True and info["replicas"] == 2
+        assert len(info["replica_ports"]) == 2
+        port = info["port"]
+        code, out = _http("POST", port, "/predict",
+                          {"rows": [{"c0": 1.5}, {"c0": -1.0}]})
+        assert code == 200
+        assert out["scores"] == [pytest.approx(3.0), pytest.approx(-2.0)]
+        assert out["version"] == 1 and out["replica"] in (0, 1)
+        code, ready = _http("GET", port, "/readyz")
+        assert code == 200 and ready["ready"] is True
+        code, m = _http("GET", port, "/metrics")
+        assert code == 200 and m["fleet"]["ready"] == 2
+        for rid, info_r in m["replicas"].items():
+            assert info_r["replica_id"] == int(rid)
+            assert info_r["state"] == "ready"
+        # cache: the same rows again hit replica-side cache (bit-identical)
+        code, again = _http("POST", port, "/predict",
+                            {"rows": [{"c0": 1.5}, {"c0": -1.0}]})
+        assert code == 200 and again["scores"] == out["scores"]
+        code, body = _http("POST", port, "/admin/pin", {})
+        assert code == 200 and body["ok"] is True
+        assert sorted(body["replicas"]) == ["0", "1"]
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=60.0) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10.0)

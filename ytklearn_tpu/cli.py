@@ -497,6 +497,23 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--watch-interval", type=float, default=None,
                     help="model-file fingerprint poll seconds for hot reload "
                     "(default 5; 0 disables; env YTK_SERVE_WATCH_S)")
+    ap.add_argument("--replicas", type=int, default=None,
+                    help="serving fleet size: N spawns N replica worker "
+                    "processes behind a shared-nothing front (-1 = one per "
+                    "device, or per core on CPU; 0 = single-process; env "
+                    "YTK_SERVE_REPLICAS — see docs/serving.md)")
+    ap.add_argument("--slo-ms", type=float, default=None,
+                    help="p99 latency SLO in ms for the AIMD batch-size "
+                    "controller (0 disables AIMD and restores the fixed "
+                    "--max-batch/--max-wait-ms; env YTK_SERVE_SLO_MS, "
+                    "default 100)")
+    ap.add_argument("--cache-rows", type=int, default=None,
+                    help="bounded LRU prediction-cache rows, keyed on "
+                    "(model fingerprint, feature row); 0 disables (env "
+                    "YTK_SERVE_CACHE_ROWS)")
+    ap.add_argument("--replica-id", type=int, default=None,
+                    help="fleet-internal: this process is replica N (set by "
+                    "the front; stamps obs identity for postmortems)")
     ap.add_argument("--set", action="append", dest="sets", metavar="KEY=VALUE",
                     help="config override, repeatable")
     ap.add_argument("--trace-out", default="",
@@ -506,8 +523,26 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
     _setup_logging(args.verbose)
     _setup_trace(args.trace_out)
 
+    from .config import knobs
+
+    replicas = (args.replicas if args.replicas is not None
+                else knobs.get_int("YTK_SERVE_REPLICAS"))
+    slo_ms = (args.slo_ms if args.slo_ms is not None
+              else knobs.get_float("YTK_SERVE_SLO_MS"))
+    cache_rows = (args.cache_rows if args.cache_rows is not None
+                  else knobs.get_int("YTK_SERVE_CACHE_ROWS"))
+
+    if replicas != 0:
+        return _serve_fleet_main(args, replicas, slo_ms, cache_rows)
+
     from .config import hocon
+    from . import obs
     from .serve import BatchPolicy, ModelRegistry, ServeApp, parse_ladder
+
+    if args.replica_id is not None:
+        # fleet worker: every obs event / flight dump / metrics scrape
+        # from this process names its replica
+        obs.set_identity(replica_id=args.replica_id)
 
     cfg = _apply_overrides(hocon.load(args.config_path), args.sets)
     ladder = parse_ladder(args.ladder) if args.ladder else None
@@ -520,13 +555,17 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
         max_queue=args.max_queue,
         default_deadline_ms=args.deadline_ms,
     )
-    app = ServeApp(registry, policy, host=args.host, port=args.port).start()
+    app = ServeApp(
+        registry, policy, host=args.host, port=args.port,
+        slo_ms=slo_ms, cache_rows=cache_rows, replica_id=args.replica_id,
+    ).start()
     app.install_signal_handlers()
     print(json.dumps({
         "serving": args.name,
         "model": args.model_name,
         "host": args.host,
         "port": app.port,
+        "replica_id": args.replica_id,
         "ladder": list(registry.get(args.name).scorer.ladder),
     }), flush=True)
     try:
@@ -534,6 +573,69 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
             app._serve_thread.join(timeout=1.0)
     except KeyboardInterrupt:
         app.stop(drain=True)
+    _flush_trace(args.trace_out)
+    return 0
+
+
+def _serve_fleet_main(args, replicas: int, slo_ms, cache_rows) -> int:
+    """`serve --replicas N`: front process owning N worker subprocesses."""
+    from .serve import (
+        BatchPolicy,
+        FleetFront,
+        default_replica_count,
+        serve_worker_argv,
+    )
+
+    if replicas < 0:
+        replicas = default_replica_count()
+    worker_flags = []
+    for flag, val in (
+        ("--name", args.name),
+        ("--ladder", args.ladder),
+        ("--max-batch", args.max_batch),
+        ("--max-wait-ms", args.max_wait_ms),
+        ("--max-queue", args.max_queue),
+        ("--deadline-ms", args.deadline_ms),
+        ("--watch-interval", args.watch_interval),
+        ("--slo-ms", slo_ms),
+        ("--cache-rows", cache_rows),
+    ):
+        if val not in (None, ""):
+            worker_flags += [flag, str(val)]
+    for s in args.sets or []:
+        worker_flags += ["--set", s]
+    if args.verbose:
+        worker_flags.append("--verbose")
+    front = FleetFront(
+        serve_worker_argv(args.config_path, args.model_name, worker_flags),
+        replicas,
+        policy=BatchPolicy(
+            max_batch=args.max_batch,
+            max_wait_ms=min(args.max_wait_ms, 1.0),
+            max_queue=args.max_queue,
+            default_deadline_ms=args.deadline_ms,
+        ),
+        host=args.host,
+        port=args.port,
+    )
+    front.start().serve_http()
+    front.install_signal_handlers()
+    print(json.dumps({
+        "serving": args.name,
+        "model": args.model_name,
+        "host": args.host,
+        "port": front.port,
+        "replicas": replicas,
+        "fleet": True,
+        "replica_ports": {
+            str(rid): h.port for rid, h in sorted(front.handles.items())
+        },
+    }), flush=True)
+    try:
+        while front._serve_thread is not None and front._serve_thread.is_alive():
+            front._serve_thread.join(timeout=1.0)
+    except KeyboardInterrupt:
+        front.stop(drain=True)
     _flush_trace(args.trace_out)
     return 0
 

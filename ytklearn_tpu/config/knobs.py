@@ -199,6 +199,28 @@ _knob("YTK_SERVE_LADDER", "str", None,
 _knob("YTK_SERVE_WATCH_S", "float", 5.0,
       "serving hot-reload fingerprint poll interval in seconds "
       "(`0` disables the watcher)")
+_knob("YTK_SERVE_REPLICAS", "int", 0,
+      "serving fleet size: replica worker processes behind the front "
+      "(`0` = single-process serving, `-1` = one per device, or per core "
+      "on CPU; CLI `--replicas` overrides — see [serving.md](serving.md))")
+_knob("YTK_SERVE_SLO_MS", "float", 100.0,
+      "serving p99 latency SLO in ms — the target the AIMD batch-size "
+      "controller searches under (`0` disables the controller and "
+      "restores the fixed `--max-batch`/`--max-wait-ms` knobs)")
+_knob("YTK_SERVE_CACHE_ROWS", "int", 0,
+      "bounded LRU prediction-cache capacity in rows, keyed on (model "
+      "fingerprint, feature-row hash); hits bypass the batcher queue and "
+      "are bit-identical to the scored path (`0` disables)")
+_knob("YTK_SERVE_AIMD_INC", "int", 8,
+      "AIMD additive-increase step in rows per clean adjustment window "
+      "(the raw target then snaps DOWN to a compiled ladder rung)")
+_knob("YTK_SERVE_AIMD_BACKOFF", "float", 0.5,
+      "AIMD multiplicative backoff factor applied to the raw batch "
+      "target on a p99-SLO violation (must be in (0, 1))")
+_knob("YTK_SERVE_AIMD_WINDOW", "int", 16,
+      "batches per AIMD adjustment window: the controller judges the "
+      "window's worst observed request latency against the SLO once per "
+      "window, so one straggler cannot collapse the batch size")
 
 # -- bench ------------------------------------------------------------------
 _knob("YTK_CHIP", "str", "v5e",

@@ -36,6 +36,7 @@ from .core import (  # noqa: F401
     inc,
     record_collective,
     reset,
+    set_identity,
     snapshot,
     span,
 )

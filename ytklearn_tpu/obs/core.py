@@ -109,6 +109,19 @@ class Registry:
 
 REGISTRY = Registry()
 
+#: process identity attached to every obs event + flight dump (serve fleet:
+#: a replica worker stamps its replica_id here at startup, so a fleet
+#: postmortem names the sick replica instead of "some pid"). Empty = solo
+#: process, nothing is attached. Written once at process start, read-only
+#: after — no lock needed.
+IDENTITY: Dict[str, object] = {}
+
+
+def set_identity(**kw) -> None:
+    """Stamp process identity (e.g. replica_id=3) onto every subsequent
+    obs event and flight dump. Values must be JSON-serializable."""
+    IDENTITY.update({k: v for k, v in kw.items() if v is not None})
+
 
 class _State:
     __slots__ = ("enabled", "trace_path", "jsonl_path", "jax_annotations")
@@ -249,6 +262,8 @@ def event(name: str, **args) -> None:
         "tid": threading.get_ident(),
         "depth": len(REGISTRY._stack()),
     }
+    if IDENTITY:
+        args = {**IDENTITY, **args} if args else dict(IDENTITY)
     if args:
         ev["args"] = args
     REGISTRY.add_event(ev)

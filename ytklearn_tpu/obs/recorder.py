@@ -128,6 +128,9 @@ def _runtime_info() -> dict:
         "python": sys.version.split()[0],
         "platform": platform.platform(),
     }
+    if core.IDENTITY:
+        # fleet postmortems must name the replica, not just a pid
+        info["identity"] = dict(core.IDENTITY)
     # jax/device facts are best-effort: the dump must succeed even when the
     # crash IS a broken jax runtime
     try:

@@ -56,6 +56,9 @@ FAULT_SITES: Dict[str, str] = {
     "continual.copy": "continual shadow/archive chunked file copy",
     "continual.promote": "continual promotion/restore per-file replace",
     "serve.load": "serve registry warm load (initial load + hot reload)",
+    "serve.worker": "serve replica worker /predict hot path (fleet front "
+                    "restart drill — kind=kill takes one replica down "
+                    "mid-load)",
 }
 
 KINDS = ("oserror", "error", "sigterm", "kill")

@@ -15,10 +15,15 @@ star asks for ("serve heavy traffic from millions of users"):
                    the replacement scorer is warmed BEFORE an atomic swap
   ServeApp         stdlib ThreadingHTTPServer exposing /predict, /healthz,
                    /readyz, and /metrics (obs registry snapshot + latency
-                   percentiles); SIGTERM drains in-flight work
+                   percentiles); SIGTERM drains in-flight work; optional
+                   AIMD batch-size controller + LRU prediction cache
+  fleet/           multi-process serving fleet: FleetFront spawns N
+                   replica workers (one full stack each), balances on
+                   least-queued-rows, heals crashes, fans out admin, and
+                   aggregates fleet metrics (ring-union p99)
 
-CLI: `python -m ytklearn_tpu.cli serve <conf> <model_name>` /
-`ytklearn-tpu-serve` (cli.py).
+CLI: `python -m ytklearn_tpu.cli serve <conf> <model_name> [--replicas N]`
+/ `ytklearn-tpu-serve` (cli.py).
 """
 
 from __future__ import annotations
@@ -33,17 +38,29 @@ from .batcher import (  # noqa: F401
 from .registry import ModelRegistry, model_fingerprint  # noqa: F401
 from .scorer import DEFAULT_LADDER, CompiledScorer, parse_ladder  # noqa: F401
 from .server import ServeApp  # noqa: F401
+from .fleet import (  # noqa: F401
+    AIMDController,
+    FleetFront,
+    PredictionCache,
+    default_replica_count,
+    serve_worker_argv,
+)
 
 __all__ = [
+    "AIMDController",
     "BatchPolicy",
     "CompiledScorer",
     "DEFAULT_LADDER",
     "DeadlineExceeded",
+    "FleetFront",
     "MicroBatcher",
     "ModelRegistry",
     "OverloadError",
+    "PredictionCache",
     "ServeApp",
     "ServeClosed",
+    "default_replica_count",
     "model_fingerprint",
     "parse_ladder",
+    "serve_worker_argv",
 ]

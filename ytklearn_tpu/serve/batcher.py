@@ -58,25 +58,47 @@ class _Pending:
     """One submitted request: rows in, result (or typed error) out.
 
     The worker stores the whole batch result + this request's offset; the
-    slice happens in get() on the caller's thread, keeping the worker's
-    per-request cost to one Event.set."""
+    slice happens in get() on the caller's thread. Completion signaling
+    is LAZY: the common high-throughput pattern (a deep in-flight window
+    where results land before get() is called) pays one flag write under
+    a shared lock per request, and a threading.Event is allocated and set
+    only when a caller actually has to block — at 30k req/s the per-
+    request Event create + set was a measurable slice of the front's GIL
+    budget (scripts/serve_bench.py --fleet found it)."""
 
-    __slots__ = ("rows", "done", "result", "meta", "_off", "error", "t_enq",
-                 "deadline")
+    __slots__ = ("rows", "result", "meta", "_off", "error", "t_enq",
+                 "deadline", "_done", "_event", "_sig")
 
-    def __init__(self, rows, deadline: Optional[float]):
+    def __init__(self, rows, deadline: Optional[float], sig: threading.Lock):
         self.rows = rows
-        self.done = threading.Event()
         self.result = None  # (batch_scores, batch_preds) shared by the batch
         self.meta = None  # score_fn's optional 3rd return (e.g. model entry)
         self._off = 0
         self.error: Optional[BaseException] = None
         self.t_enq = time.perf_counter()
         self.deadline = deadline  # perf_counter timestamp or None
+        self._done = False
+        self._event: Optional[threading.Event] = None
+        self._sig = sig  # shared per-batcher signal lock (lost-wake guard)
+
+    def finish(self) -> None:
+        """Worker side: result/meta/error fields are set — publish. The
+        flag flip and the waiter's event creation are serialized by the
+        shared lock, so a wake can never be lost."""
+        with self._sig:
+            self._done = True
+            ev = self._event
+        if ev is not None:
+            ev.set()
 
     def get(self, timeout: Optional[float] = None):
-        if not self.done.wait(timeout):
-            raise TimeoutError("serve request did not complete in time")
+        if not self._done:
+            with self._sig:
+                if not self._done and self._event is None:
+                    self._event = threading.Event()
+                ev = self._event if not self._done else None
+            if ev is not None and not ev.wait(timeout):
+                raise TimeoutError("serve request did not complete in time")
         if self.error is not None:
             raise self.error
         scores, preds = self.result
@@ -95,12 +117,23 @@ class MicroBatcher:
     for any number of producers.
     """
 
-    def __init__(self, score_fn: Callable, policy: Optional[BatchPolicy] = None):
+    def __init__(
+        self,
+        score_fn: Callable,
+        policy: Optional[BatchPolicy] = None,
+        controller=None,
+    ):
         self.score_fn = score_fn
         self.policy = policy or BatchPolicy()
+        # optional AIMD batch-size controller (serve/fleet/aimd.py): when
+        # set, it supplies max_batch/max_wait_ms live (snapped to the
+        # compiled ladder) and is fed per-request latencies by the worker;
+        # None keeps the fixed BatchPolicy knobs
+        self.controller = controller
         self._queue: collections.deque = collections.deque()
         self._queued_rows = 0  # maintained with _queue; O(1) linger checks
         self._lock = threading.Lock()
+        self._sig = threading.Lock()  # _Pending completion signaling
         self._not_empty = threading.Condition(self._lock)
         self._closing = False
         self._closed = False
@@ -124,7 +157,7 @@ class MicroBatcher:
             time.perf_counter() + deadline_ms / 1e3 if deadline_ms and deadline_ms > 0
             else None
         )
-        req = _Pending(list(rows), deadline)
+        req = _Pending(list(rows), deadline, self._sig)
         with self._not_empty:
             if self._closing:
                 raise ServeClosed("serve batcher is draining")
@@ -141,7 +174,7 @@ class MicroBatcher:
             # wake the worker only on the transitions it acts on (first
             # request, or a full batch ready); notifying every submit makes
             # the linger window a notify/wake ping-pong that caps throughput
-            if was_empty or self._queued_rows >= self.policy.max_batch:
+            if was_empty or self._queued_rows >= self._max_batch():
                 self._not_empty.notify()
         return req
 
@@ -151,10 +184,19 @@ class MicroBatcher:
 
     # -- worker side ------------------------------------------------------
 
+    def _max_batch(self) -> int:
+        c = self.controller
+        return c.max_batch if c is not None else self.policy.max_batch
+
+    def _max_wait_ms(self) -> float:
+        c = self.controller
+        return c.max_wait_ms if c is not None else self.policy.max_wait_ms
+
     def _take_batch(self) -> Optional[List[_Pending]]:
         """Block for the first request, linger max_wait_ms for more, then
         take up to max_batch rows' worth. None = closed and drained."""
-        wait_s = self.policy.max_wait_ms / 1e3
+        wait_s = self._max_wait_ms() / 1e3
+        max_batch = self._max_batch()
         with self._not_empty:
             while not self._queue:
                 if self._closing:
@@ -162,7 +204,7 @@ class MicroBatcher:
                 self._not_empty.wait(timeout=0.05)
             if wait_s > 0 and not self._closing:
                 deadline = time.perf_counter() + wait_s
-                while self._queued_rows < self.policy.max_batch:
+                while self._queued_rows < max_batch:
                     remaining = deadline - time.perf_counter()
                     if remaining <= 0:
                         break
@@ -171,7 +213,7 @@ class MicroBatcher:
             n_rows = 0
             while self._queue:
                 nxt = len(self._queue[0].rows)
-                if batch and n_rows + nxt > self.policy.max_batch:
+                if batch and n_rows + nxt > max_batch:
                     break
                 req = self._queue.popleft()
                 batch.append(req)
@@ -194,7 +236,7 @@ class MicroBatcher:
                         f"deadline expired after "
                         f"{(now - req.t_enq) * 1e3:.1f} ms in queue"
                     )
-                    req.done.set()
+                    req.finish()
                 else:
                     live.append(req)
             if not live:
@@ -215,18 +257,25 @@ class MicroBatcher:
                 obs_inc("serve.batch_rows", len(rows))
                 result = (scores, preds)
                 off = 0
+                t_done = time.perf_counter()
                 for req in live:
                     req.result = result
                     req.meta = meta
                     req._off = off
                     off += len(req.rows)
-                    req.done.set()
+                    req.finish()
+                    if self.controller is not None:
+                        # client-visible latency (enqueue -> scored): the
+                        # number the SLO is written against
+                        self.controller.observe((t_done - req.t_enq) * 1e3)
+                if self.controller is not None:
+                    self.controller.note_batch()
             except Exception as e:  # noqa: BLE001 — fail the requests, not the worker
                 obs_inc("serve.batch_errors")
                 obs_event("serve.batch_error", error=type(e).__name__)
                 for req in live:
                     req.error = e
-                    req.done.set()
+                    req.finish()
         self._closed = True
 
     # -- shutdown ---------------------------------------------------------
@@ -239,7 +288,7 @@ class MicroBatcher:
             if not drain:
                 for req in self._queue:
                     req.error = ServeClosed("serve batcher closed")
-                    req.done.set()
+                    req.finish()
                 self._queue.clear()
                 self._queued_rows = 0
                 obs_gauge("serve.queue_depth", 0)

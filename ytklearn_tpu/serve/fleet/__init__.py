@@ -1,0 +1,57 @@
+"""ytklearn_tpu.serve.fleet — multi-process serving fleet (docs/serving.md).
+
+The r9 server is one process: one GIL, one device, one latency ring. This
+package is the layer that turns it into a fleet — the two Clipper layers
+r9 deferred (AIMD adaptive batching, bounded prediction cache) plus the
+multi-replica fan-out itself:
+
+  FleetFront        shared-nothing front process: spawns N replica
+                    workers (each the full r9 stack in its own process),
+                    balances on least-queued-rows, coalesces client
+                    requests into per-replica batched forwards, reroutes
+                    around and restarts crashed/wedged replicas, fans
+                    /admin/* out fleet-wide, and aggregates /metrics with
+                    a replica latency-ring union (fleet p99 is real)
+  AIMDController    searches the largest batch size meeting the p99 SLO
+                    (additive increase / multiplicative backoff), always
+                    snapped to the compiled shape ladder so adaptation
+                    never retraces
+  PredictionCache   bounded LRU keyed on (model fingerprint, feature
+                    row); hits bypass the batcher queue and are
+                    bit-identical to the scored path; hot reload
+                    invalidates by key, for free
+
+CLI: `ytklearn-tpu-serve <conf> <model> --replicas N` (cli.py).
+"""
+
+from __future__ import annotations
+
+from .aimd import AIMDController, maybe_controller  # noqa: F401
+from .cache import PredictionCache, maybe_cache, row_key  # noqa: F401
+from .front import FleetFront, latency_percentiles  # noqa: F401
+from .worker import (  # noqa: F401
+    ReplicaHandle,
+    WorkerStartupError,
+    default_replica_count,
+    http_json,
+    serve_worker_argv,
+    spawn_replica,
+    stop_replica,
+)
+
+__all__ = [
+    "AIMDController",
+    "FleetFront",
+    "PredictionCache",
+    "ReplicaHandle",
+    "WorkerStartupError",
+    "default_replica_count",
+    "http_json",
+    "latency_percentiles",
+    "maybe_cache",
+    "maybe_controller",
+    "row_key",
+    "serve_worker_argv",
+    "spawn_replica",
+    "stop_replica",
+]

@@ -1,0 +1,135 @@
+"""Bounded LRU prediction cache — Clipper's other deferred layer.
+
+Online traffic is heavy-tailed: a small set of hot feature rows (the
+popular item, the returning user) accounts for a large share of requests.
+Clipper (NSDI'17 §4.1) puts a prediction cache in front of the batching
+queue so those rows cost a dict lookup instead of a scorer pass. Rules:
+
+  key        (model fingerprint + version, exact feature-row tuple) — the
+             row itself is the key, not a hash of it, so a collision can
+             never serve another row's prediction
+  values     the (score, prediction) the SCORED path produced, stored
+             per row — a hit is bit-identical to a cold request by
+             construction (test-pinned)
+  bound      `YTK_SERVE_CACHE_ROWS` rows, LRU eviction
+             (`serve.cache.evict` counts)
+  invalidation  free: the fingerprint/version in the key changes when the
+             registry hot-swaps an entry, so every stale row simply stops
+             matching and ages out of the LRU — no flush, no lock sweep,
+             no coordination with the reload path
+  writes     only from scored batches, keyed by the entry that ACTUALLY
+             scored them (the batch meta), never by the entry that was
+             current at submit time — a hot reload between submit and
+             score must not poison the cache with mislabeled rows
+
+Counters: `serve.cache.hit` / `serve.cache.miss` / `serve.cache.evict`
+(+ `serve.cache.rows` gauge) land in `/metrics`.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ...config import knobs
+from ...obs import gauge as obs_gauge, inc as obs_inc
+
+
+def row_key(row: Dict[str, float]) -> tuple:
+    """A feature-dict row as a canonical hashable key (sorted items —
+    insertion order must not split identical rows into distinct keys)."""
+    return tuple(sorted(row.items()))
+
+
+class PredictionCache:
+    """LRU of (model key, row key) -> (score, prediction) scalars/rows."""
+
+    def __init__(self, max_rows: Optional[int] = None):
+        if max_rows is None:
+            max_rows = knobs.get_int("YTK_SERVE_CACHE_ROWS")
+        self.max_rows = max(0, int(max_rows))
+        self._lru: OrderedDict = OrderedDict()
+        self._lock = threading.Lock()
+
+    @property
+    def enabled(self) -> bool:
+        return self.max_rows > 0
+
+    @staticmethod
+    def model_key(entry) -> tuple:
+        """The invalidation half of the cache key: fingerprint + version
+        of a registry entry. A hot reload (new fingerprint, bumped
+        version) or a rollback (older version) changes it, so stale rows
+        never match again."""
+        return (entry.fingerprint, entry.version)
+
+    def lookup(
+        self, model_key: tuple, rows: Sequence[Dict[str, float]]
+    ) -> Optional[list]:
+        """All-or-nothing: the per-row (score, pred) list when EVERY row
+        hits, else None (partial hits still ride the scored path, so a
+        response is always one model version end to end). Both counters
+        are in ROWS — hit rows bypassed the scorer, miss rows rode the
+        scored path — so hit/(hit+miss) is a true row hit rate even for
+        multi-row requests."""
+        if not self.enabled:
+            return None
+        out = []
+        with self._lock:
+            for row in rows:
+                k = (model_key, row_key(row))
+                hit = self._lru.get(k)
+                if hit is None:
+                    obs_inc("serve.cache.miss", len(rows))
+                    return None
+                self._lru.move_to_end(k)
+                out.append(hit)
+        obs_inc("serve.cache.hit", len(rows))
+        return out
+
+    def store(
+        self, model_key: tuple, rows: Sequence[Dict[str, float]], scores, preds
+    ) -> None:
+        """Insert scored rows (score_i, pred_i from the batch arrays)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            for i, row in enumerate(rows):
+                k = (model_key, row_key(row))
+                s, p = scores[i], preds[i]
+                # multi-output models: scores[i] on a (B, K) array is a
+                # VIEW whose .base pins the whole batch array — a
+                # "bounded" cache of views can hold gigabytes. Scalars
+                # (1-D indexing) are already copies.
+                if isinstance(s, np.ndarray):
+                    s = np.array(s, copy=True)
+                if isinstance(p, np.ndarray):
+                    p = np.array(p, copy=True)
+                self._lru[k] = (s, p)
+                self._lru.move_to_end(k)  # re-stored keys keep recency
+            evicted = 0
+            while len(self._lru) > self.max_rows:
+                self._lru.popitem(last=False)
+                evicted += 1
+            n = len(self._lru)
+        if evicted:
+            obs_inc("serve.cache.evict", evicted)
+        obs_gauge("serve.cache.rows", n)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._lru)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._lru.clear()
+        obs_gauge("serve.cache.rows", 0)
+
+
+def maybe_cache(max_rows: Optional[int] = None) -> Optional[PredictionCache]:
+    """A PredictionCache when the rows knob (or explicit arg) is > 0."""
+    cache = PredictionCache(max_rows)
+    return cache if cache.enabled else None

@@ -1,0 +1,766 @@
+"""Shared-nothing serving front: spawn, balance, heal a replica fleet.
+
+One front process owns N replica workers (worker.py), each a complete
+single-process server on its own ephemeral localhost port. The front
+holds no model state at all — it only moves rows:
+
+  balance    every client request goes WHOLE to one replica — picked by
+             least queued rows (forwarder backlog + rows already in HTTP
+             flight), so a replica digesting a big batch stops receiving
+             before it builds a queue
+  coalesce   a per-replica *forwarder* (the same MicroBatcher the replica
+             runs internally) packs concurrent client requests into one
+             HTTP POST, so front<->replica framing is paid per batch, not
+             per request — without it the fleet would be capped by
+             per-request HTTP overhead, not by the scorers
+  heal       a monitor thread watches child liveness + `/readyz`; a
+             crashed or wedged replica is marked dead, its traffic
+             reroutes, and the slot is respawned (`serve.worker.died` /
+             `serve.worker.restarted` evidence). In-flight batches that
+             die with a replica are rerouted to a sibling — the
+             transient-vs-fatal split is `resilience.retry.is_transient`
+             (a connection reset reroutes; a model bug propagates)
+  propagate  `/admin/{rollback,pin,unpin}` fan out to every replica, so a
+             rollback freezes the WHOLE fleet, not one process. Hot
+             reload needs no fan-out: each replica's own registry watcher
+             picks up the dump, and every batch is still scored by
+             exactly one entry inside one replica — the one-version-per-
+             batch guarantee survives fleet-wide because requests are
+             never split across replicas
+  aggregate  `/metrics` unions the replicas' raw latency rings before
+             taking percentiles — fleet p99 is computed over every
+             replica's samples (a per-replica p99 cannot be averaged,
+             and replica-0's p99 is not the fleet's)
+
+The front's own hot path is pure-python dict/queue work; scoring
+parallelism comes from the replica processes (one GIL each).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import signal
+import threading
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ...obs import (
+    event as obs_event,
+    gauge as obs_gauge,
+    inc as obs_inc,
+    snapshot as obs_snapshot,
+    span as obs_span,
+)
+from ...resilience import is_transient
+from ..batcher import (
+    BatchPolicy,
+    DeadlineExceeded,
+    MicroBatcher,
+    OverloadError,
+    ServeClosed,
+)
+from .worker import ReplicaHandle, http_json, spawn_replica, stop_replica
+
+log = logging.getLogger("ytklearn_tpu.serve.fleet")
+
+#: consecutive /readyz failures before a live-but-unresponsive replica is
+#: declared wedged and recycled
+WEDGE_STRIKES = 3
+
+
+def latency_percentiles(vals: List[float]) -> Dict[str, float]:
+    """THE latency-percentile computation — server._LatencyWindow
+    delegates here, so per-replica and fleet-union payloads can't
+    diverge."""
+    if not vals:
+        return {"count": 0}
+    arr = np.asarray(vals)
+    return {
+        "count": len(vals),
+        "p50_ms": round(float(np.percentile(arr, 50)), 3),
+        "p99_ms": round(float(np.percentile(arr, 99)), 3),
+        "p999_ms": round(float(np.percentile(arr, 99.9)), 3),
+        "max_ms": round(float(arr.max()), 3),
+    }
+
+
+class FleetFront:
+    """Owns the replica fleet; predict()/admin()/metrics_payload() are the
+    API, start()/stop() the lifecycle, serve_http() the listener."""
+
+    def __init__(
+        self,
+        worker_argv: List[str],
+        replicas: int,
+        policy: Optional[BatchPolicy] = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        ready_timeout_s: float = 180.0,
+        monitor_interval_s: float = 0.25,
+        forward_timeout_s: float = 60.0,
+        log_dir: Optional[str] = None,
+    ):
+        if replicas < 1:
+            raise ValueError(f"fleet needs >= 1 replica, got {replicas}")
+        self.worker_argv = list(worker_argv)
+        self.n_replicas = replicas
+        self.policy = policy or BatchPolicy()
+        self.host = host
+        self.port = port
+        self.ready_timeout_s = ready_timeout_s
+        self.monitor_interval_s = monitor_interval_s
+        self.forward_timeout_s = forward_timeout_s
+        self.log_dir = log_dir
+        self.handles: Dict[int, ReplicaHandle] = {}
+        self._forwarders: Dict[int, MicroBatcher] = {}
+        # rows currently inside an HTTP round-trip per replica; updated
+        # under a lock (dict read-modify-write is several bytecodes — a
+        # lost update would skew least-queued-rows balancing FOREVER, the
+        # counter is never reconciled); touched once per forwarded batch,
+        # not per request, so the lock is off the per-request path
+        self._inflight: Dict[int, int] = {}
+        self._inflight_lock = threading.Lock()
+        self._strikes: Dict[int, int] = {}
+        self._restart_not_before: Dict[int, float] = {}
+        self._respawns: Dict[int, threading.Thread] = {}
+        self.latency = None  # front-side client-visible ring, set in start()
+        self.draining = False
+        self._closing = False
+        self._monitor: Optional[threading.Thread] = None
+        self._stop_evt = threading.Event()
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._serve_thread: Optional[threading.Thread] = None
+        self._started_at = time.time()
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> "FleetFront":
+        from ..server import _LatencyWindow  # shared ring implementation
+
+        self.latency = _LatencyWindow()
+        errors: Dict[int, BaseException] = {}
+
+        def _spawn(rid: int) -> None:
+            try:
+                h = spawn_replica(
+                    self.worker_argv, rid, env=None, log_dir=self.log_dir,
+                    ready_timeout_s=self.ready_timeout_s,
+                )
+                self.handles[rid] = h
+            except Exception as e:  # noqa: BLE001 — collected and re-raised below
+                errors[rid] = e
+
+        threads = [
+            threading.Thread(target=_spawn, args=(rid,), daemon=True,
+                             name=f"ytk-fleet-spawn-{rid}")
+            for rid in range(self.n_replicas)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            for h in self.handles.values():
+                stop_replica(h, timeout_s=10.0)
+            rid, err = sorted(errors.items())[0]
+            raise RuntimeError(
+                f"fleet startup failed: replica {rid}: {err}"
+            ) from err
+        for rid in range(self.n_replicas):
+            self._forwarders[rid] = MicroBatcher(
+                self._make_score_fn(rid), self.policy
+            )
+            with self._inflight_lock:
+                self._inflight[rid] = 0
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, name="ytk-fleet-monitor", daemon=True
+        )
+        self._monitor.start()
+        obs_gauge("serve.fleet.replicas", self.n_replicas)
+        log.info("fleet: %d replica(s) up: %s", self.n_replicas,
+                 {rid: h.port for rid, h in sorted(self.handles.items())})
+        return self
+
+    def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
+        self.draining = True
+        self._closing = True
+        self._stop_evt.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout=10.0)
+        # in-flight respawns see _closing (spawn abort + early h.proc
+        # publication) — join them so no freshly-spawned worker outlives us
+        for t in self._respawns.values():
+            t.join(timeout=15.0)
+        for f in self._forwarders.values():
+            f.close(drain=drain, timeout=timeout)
+        stoppers = [
+            threading.Thread(target=stop_replica, args=(h, timeout),
+                             daemon=True)
+            for h in self.handles.values()
+        ]
+        for t in stoppers:
+            t.start()
+        for t in stoppers:
+            t.join(timeout=timeout + 10.0)
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        log.info("fleet: stopped (drained=%s)", drain)
+
+    # -- forwarding -------------------------------------------------------
+
+    def _ready_ids(self) -> List[int]:
+        return [rid for rid, h in self.handles.items() if h.state == "ready"]
+
+    def _load_of(self, rid: int) -> int:
+        f = self._forwarders.get(rid)
+        queued = f._queued_rows if f is not None else 0
+        return queued + self._inflight.get(rid, 0)
+
+    def _pick_replica(self) -> int:
+        """Least-queued-rows among ready replicas. Hand-rolled single pass
+        (no list builds, no bound-method calls): this runs once per client
+        request and showed up in the fleet bench profile."""
+        best = -1
+        best_load = None
+        inflight = self._inflight
+        forwarders = self._forwarders
+        for rid, h in self.handles.items():
+            if h.state != "ready":
+                continue
+            f = forwarders.get(rid)
+            load = ((f._queued_rows if f is not None else 0)
+                    + inflight.get(rid, 0))
+            if best_load is None or load < best_load:
+                best, best_load = rid, load
+        if best < 0:
+            raise ServeClosed("no ready replica (fleet restarting?)")
+        return best
+
+    @staticmethod
+    def _encode_rows(rows, model: Optional[str] = None,
+                     deadline_ms: Optional[float] = None) -> str:
+        """Forward-body builder with a raw-splice fast path: a row may be
+        a feature dict OR a pre-serialized JSON object string (what an
+        HTTP gateway already holds as request bytes, and what the fleet
+        bench pre-encodes). Splicing fragments is a C-speed str.join;
+        re-encoding 512 row dicts per batch was the front's single
+        biggest GIL cost (14us/row, scripts/serve_bench.py --fleet)."""
+        parts = [r if isinstance(r, str) else json.dumps(r) for r in rows]
+        body = '{"rows":[' + ",".join(parts) + "]"
+        if model is not None:
+            body += ',"model":' + json.dumps(model)
+        if deadline_ms is not None and deadline_ms > 0:
+            body += ',"deadline_ms":' + json.dumps(round(deadline_ms, 3))
+        return body + "}"
+
+    def _post_predict(self, rid: int, rows, model: Optional[str] = None,
+                      deadline_ms: Optional[float] = None) -> tuple:
+        """One POST to replica `rid`; raises typed errors for non-200."""
+        h = self.handles[rid]
+        with self._inflight_lock:
+            self._inflight[rid] = self._inflight.get(rid, 0) + len(rows)
+        try:
+            status, body = http_json(
+                "POST", h.port, "/predict",
+                self._encode_rows(rows, model, deadline_ms),
+                timeout=self.forward_timeout_s,
+            )
+        finally:
+            with self._inflight_lock:
+                self._inflight[rid] = self._inflight.get(rid, 0) - len(rows)
+        if status == 200:
+            meta = {
+                "version": body.get("version"),
+                "model": body.get("model"),
+                "replica_id": rid,
+                "cached": bool(body.get("cached")),
+            }
+            return (
+                np.asarray(body["scores"]),
+                np.asarray(body["predictions"]),
+                meta,
+            )
+        err = body.get("error", f"replica {rid} HTTP {status}")
+        if status == 429:
+            raise OverloadError(err)
+        if status == 504:
+            raise DeadlineExceeded(err)
+        if status == 503:
+            # replica draining (it got a SIGTERM the front didn't send):
+            # treat like a connection-level loss -> reroute
+            raise ConnectionResetError(f"replica {rid} draining: {err}")
+        if status == 404:
+            raise KeyError(err)
+        raise RuntimeError(f"replica {rid} HTTP {status}: {err}")
+
+    def _make_score_fn(self, rid: int):
+        def score_fn(rows):
+            h = self.handles[rid]
+            if h.state == "ready":
+                try:
+                    return self._post_predict(rid, rows)
+                except Exception as e:
+                    if not is_transient(e):
+                        raise
+                    # connection-level loss mid-call: the replica died (or
+                    # is draining) with our batch in flight — mark it for
+                    # the monitor and move the batch to a sibling; the
+                    # client never sees the failure
+                    self._note_sick(rid, e)
+                    return self._reroute(rows, exclude=rid, cause=e)
+            return self._reroute(rows, exclude=rid, cause=None)
+
+        return score_fn
+
+    def _reroute(self, rows, exclude: int, cause,
+                 model: Optional[str] = None) -> tuple:
+        """Forward `rows` to the least-loaded OTHER ready replica, walking
+        the fleet until one answers. Exhaustion re-raises the cause."""
+        tried = {exclude}
+        while True:
+            ready = [r for r in self._ready_ids() if r not in tried]
+            if not ready:
+                if cause is not None:
+                    raise cause
+                raise ServeClosed(
+                    f"no ready replica to reroute to (replica {exclude} "
+                    f"is {self.handles[exclude].state})"
+                )
+            rid = min(ready, key=self._load_of)
+            tried.add(rid)
+            try:
+                out = self._post_predict(rid, rows, model)
+            except Exception as e:
+                if not is_transient(e):
+                    raise
+                self._note_sick(rid, e)
+                cause = e
+                continue
+            obs_inc("serve.front.reroutes")
+            obs_event(
+                "serve.front.reroute", to_replica=rid, from_replica=exclude,
+                rows=len(rows),
+                cause=type(cause).__name__ if cause else "not_ready",
+            )
+            return out
+
+    def _note_sick(self, rid: int, exc: BaseException) -> None:
+        h = self.handles.get(rid)
+        if h is None or h.state != "ready":
+            return
+        h.state = "dead"
+        obs_inc("serve.worker.died")
+        obs_event(
+            "serve.worker.died", replica_id=rid, pid=h.pid,
+            rc=h.proc.poll() if h.proc is not None else None,
+            error=f"{type(exc).__name__}: {exc}"[:200],
+        )
+        log.warning("fleet: replica %d marked dead (%s: %s)",
+                    rid, type(exc).__name__, exc)
+
+    # -- the client-facing hot path ---------------------------------------
+
+    def submit(self, rows, deadline_ms: Optional[float] = None):
+        """Async half of predict() for the default model: route to the
+        least-loaded ready replica's forwarder; returns the pending handle
+        (serve_bench drives a bounded in-flight window through this)."""
+        if self.draining:
+            raise ServeClosed("fleet front is draining")
+        rid = self._pick_replica()
+        return self._forwarders[rid].submit(rows, deadline_ms=deadline_ms)
+
+    def predict(self, rows, model: Optional[str] = None,
+                deadline_ms: Optional[float] = None, timeout: float = 60.0):
+        """Same contract as ServeApp.predict, plus `replica` in the reply.
+        Requests go WHOLE to one replica (never split), which resolves the
+        model name — a typo still 404s (KeyError) end to end. Deadlines:
+        the named-model path forwards `deadline_ms` to the replica; on the
+        coalesced path it is enforced at the FRONT's queue (dequeue-time
+        504), which in the fleet topology is where queueing happens — each
+        replica receives one pre-coalesced batch at a time, so its own
+        queue wait is ~zero."""
+        if self.draining:
+            raise ServeClosed("fleet front is draining")
+        t0 = time.perf_counter()
+        if model is not None:
+            # named-model requests skip the coalescer (the common CLI
+            # fleet serves one default model): direct, still whole
+            rid = self._pick_replica()
+            try:
+                scores, preds, meta = self._post_predict(
+                    rid, rows, model, deadline_ms
+                )
+            except Exception as e:
+                if not is_transient(e):
+                    raise
+                self._note_sick(rid, e)
+                scores, preds, meta = self._reroute(
+                    rows, exclude=rid, cause=e, model=model
+                )
+        else:
+            pending = self.submit(rows, deadline_ms=deadline_ms)
+            scores, preds = pending.get(timeout)
+            meta = pending.meta or {}
+        self.latency.record((time.perf_counter() - t0) * 1e3)
+        obs_inc("serve.front.requests")
+        obs_inc("serve.front.request_rows", len(rows))
+        out = {
+            "model": meta.get("model"),
+            "version": meta.get("version"),
+            "replica": meta.get("replica_id"),
+            "scores": np.asarray(scores).tolist(),
+            "predictions": np.asarray(preds).tolist(),
+        }
+        if meta.get("cached"):
+            out["cached"] = True  # the replica answered from its cache
+        return out
+
+    # -- healing ----------------------------------------------------------
+
+    def _monitor_loop(self) -> None:
+        while not self._stop_evt.wait(self.monitor_interval_s):
+            for rid, h in list(self.handles.items()):
+                if self._closing:
+                    return
+                try:
+                    if h.state == "ready":
+                        self._check_replica(rid, h)
+                    elif h.state == "dead":
+                        self._maybe_restart(rid, h)
+                except Exception:  # noqa: BLE001 — the monitor must survive
+                    log.exception("fleet: monitor pass for replica %d crashed",
+                                  rid)
+
+    def _check_replica(self, rid: int, h: ReplicaHandle) -> None:
+        if not h.alive():
+            self._note_sick(rid, ConnectionResetError(
+                f"worker process exited rc={h.proc.returncode}"
+            ))
+            return
+        try:
+            status, _ = http_json("GET", h.port, "/readyz", timeout=2.0)
+            ok = status == 200
+        except OSError:
+            ok = False
+        if ok:
+            self._strikes[rid] = 0
+            return
+        self._strikes[rid] = self._strikes.get(rid, 0) + 1
+        if self._strikes[rid] >= WEDGE_STRIKES:
+            # alive but unresponsive: recycle it like a crash (kill first
+            # so the old process can't come back and double-serve)
+            log.warning("fleet: replica %d wedged (%d strikes); recycling",
+                        rid, self._strikes[rid])
+            if h.proc is not None and h.proc.poll() is None:
+                h.proc.kill()
+                h.proc.wait(timeout=10.0)
+            self._strikes[rid] = 0
+            self._note_sick(rid, TimeoutError("readyz unresponsive (wedged)"))
+
+    def _maybe_restart(self, rid: int, h: ReplicaHandle) -> None:
+        """Launch an ASYNC respawn for a dead slot. The spawn itself (jax
+        import + ladder warmup, tens of seconds for a real worker) must
+        not run on the monitor thread: while one replica respawns, the
+        monitor has to keep detecting crashes/wedges on the others."""
+        if time.monotonic() < self._restart_not_before.get(rid, 0.0):
+            return
+        h.state = "starting"  # monitor + balancer skip; no double spawn
+        t = threading.Thread(
+            target=self._do_restart, args=(rid, h),
+            name=f"ytk-fleet-respawn-{rid}", daemon=True,
+        )
+        self._respawns[rid] = t
+        t.start()
+
+    def _do_restart(self, rid: int, h: ReplicaHandle) -> None:
+        # reap the corpse before respawning the slot
+        if h.proc is not None and h.proc.poll() is None:
+            h.proc.kill()
+            h.proc.wait(timeout=10.0)
+        h.restarts += 1
+        try:
+            spawn_replica(
+                self.worker_argv, rid, handle=h, log_dir=self.log_dir,
+                ready_timeout_s=self.ready_timeout_s,
+                abort=lambda: self._closing,
+            )
+        except Exception as e:  # noqa: BLE001 — retry next tick with backoff
+            delay = min(30.0, 1.0 * (2 ** min(h.restarts, 5)))
+            self._restart_not_before[rid] = time.monotonic() + delay
+            h.state = "dead"  # back to the monitor's restart queue
+            log.error(
+                "fleet: restart of replica %d failed (%s: %s); next attempt "
+                "in %.0fs", rid, type(e).__name__, e, delay,
+            )
+            return
+        if self._closing:
+            # the fleet shut down while this worker was warming: it must
+            # not outlive the front as an orphan
+            stop_replica(h, timeout_s=10.0)
+            return
+        self._strikes[rid] = 0
+        self._restart_not_before.pop(rid, None)
+        obs_inc("serve.worker.restarted")
+        obs_event(
+            "serve.worker.restarted", replica_id=rid, pid=h.pid,
+            port=h.port, restarts=h.restarts,
+        )
+        log.info("fleet: replica %d restarted (pid=%d port=%d, restart #%d)",
+                 rid, h.pid, h.port, h.restarts)
+
+    # -- admin fan-out ----------------------------------------------------
+
+    def admin(self, action: str, model: Optional[str] = None):
+        """POST /admin/<action> to every ready replica -> (all_ok, detail).
+        pin/rollback must land fleet-wide: one unpinned replica would keep
+        re-promoting the model the operator just rolled back."""
+        results: Dict[str, dict] = {}
+        ok = True
+        for rid, h in sorted(self.handles.items()):
+            if h.state != "ready":
+                results[str(rid)] = {"skipped": h.state}
+                ok = False
+                continue
+            try:
+                status, body = http_json(
+                    "POST", h.port, f"/admin/{action}",
+                    {"model": model} if model else {}, timeout=30.0,
+                )
+            except OSError as e:
+                status, body = 0, {"error": f"{type(e).__name__}: {e}"}
+            results[str(rid)] = {"status": status, **body}
+            ok = ok and status == 200
+        obs_event("serve.fleet.admin", action=action, ok=ok)
+        return ok, results
+
+    # -- status / metrics -------------------------------------------------
+
+    def ready(self) -> bool:
+        return not self.draining and bool(self._ready_ids())
+
+    def health_payload(self) -> dict:
+        return {
+            "status": "draining" if self.draining else (
+                "ok" if self.ready() else "degraded"),
+            "uptime_s": round(time.time() - self._started_at, 1),
+            "replicas": {
+                str(rid): {"state": h.state, "pid": h.pid,
+                           "restarts": h.restarts}
+                for rid, h in sorted(self.handles.items())
+            },
+        }
+
+    def _scrape_replica(self, rid: int, h: ReplicaHandle) -> dict:
+        info = {
+            "replica_id": rid,
+            "pid": h.pid,
+            "port": h.port,
+            "state": h.state,
+            "restarts": h.restarts,
+            "queued_rows": self._load_of(rid),
+        }
+        if h.state != "ready":
+            return info
+        try:
+            status, m = http_json("GET", h.port, "/metrics?raw=1",
+                                  timeout=2.0)
+        except OSError as e:
+            info["scrape_error"] = f"{type(e).__name__}: {e}"[:120]
+            return info
+        if status == 200:
+            lat = dict(m.get("latency") or {})
+            info["raw_ms"] = lat.pop("raw_ms", None) or []
+            info["latency"] = lat
+            info["queue_depth"] = m.get("queue_depth")
+            info["batching"] = m.get("batching")
+            if "cache" in m:
+                info["cache"] = m["cache"]
+            counters = m.get("counters") or {}
+            info["counters"] = {
+                k: v for k, v in counters.items()
+                if k.startswith(("serve.", "health.retrace", "chaos."))
+            }
+        return info
+
+    def metrics_payload(self) -> dict:
+        per: Dict[str, dict] = {}
+        ring_union: List[float] = []
+        total_restarts = 0
+        # scrape replicas CONCURRENTLY: one wedged replica (still 'ready'
+        # until its strikes accumulate) must not stall /metrics for the
+        # whole fleet — an operator needs visibility most mid-incident
+        handles = sorted(self.handles.items())
+        results: Dict[int, dict] = {}
+
+        def _scrape(rid, h):
+            results[rid] = self._scrape_replica(rid, h)
+
+        scrapers = [
+            threading.Thread(target=_scrape, args=(rid, h), daemon=True)
+            for rid, h in handles
+        ]
+        for t in scrapers:
+            t.start()
+        for t in scrapers:
+            t.join(timeout=5.0)
+        for rid, h in handles:
+            total_restarts += h.restarts
+            info = results.get(rid) or {
+                "replica_id": rid, "pid": h.pid, "port": h.port,
+                "state": h.state, "restarts": h.restarts,
+                "scrape_error": "scrape timed out",
+            }
+            ring_union.extend(info.pop("raw_ms", None) or [])
+            per[str(rid)] = info
+        snap = obs_snapshot()
+        return {
+            "fleet": {
+                "replicas": len(self.handles),
+                "ready": len(self._ready_ids()),
+                "restarts": total_restarts,
+            },
+            # client-visible latency measured AT the front (queue + hop +
+            # replica time) — the number an SLO dashboard should chart
+            "latency": self.latency.percentiles() if self.latency else {},
+            # replica-ring union: the fleet-wide replica-side percentile
+            # (not replica-0's, not an average of per-replica p99s)
+            "fleet_latency": latency_percentiles(ring_union),
+            "replicas": per,
+            "counters": {
+                k: round(v, 3) for k, v in sorted(snap["counters"].items())
+            },
+            "gauges": {
+                k: round(v, 4) for k, v in sorted(snap["gauges"].items())
+            },
+        }
+
+    # -- HTTP listener ----------------------------------------------------
+
+    def serve_http(self) -> "FleetFront":
+        front = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                log.debug("front http: " + fmt, *args)
+
+            def _json(self, code: int, payload: dict) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802 — stdlib handler API
+                path = urllib.parse.urlsplit(self.path).path
+                if path == "/healthz":
+                    self._json(200, front.health_payload())
+                elif path == "/readyz":
+                    ok = front.ready()
+                    self._json(200 if ok else 503,
+                               {"ready": ok,
+                                "status": "draining" if front.draining
+                                else ("ok" if ok else "no ready replica")})
+                elif path == "/metrics":
+                    self._json(200, front.metrics_payload())
+                else:
+                    self._json(404, {"error": f"unknown path {self.path}"})
+
+            def do_POST(self):  # noqa: N802
+                if self.path in ("/admin/rollback", "/admin/pin",
+                                 "/admin/unpin"):
+                    try:
+                        n = int(self.headers.get("Content-Length", 0))
+                        req = json.loads(self.rfile.read(n) or b"{}")
+                        if not isinstance(req, dict):
+                            raise ValueError("request body must be a JSON "
+                                             "object")
+                    except (ValueError, json.JSONDecodeError) as e:
+                        self._json(400, {"error": str(e),
+                                         "type": "bad_request"})
+                        return
+                    ok, detail = front.admin(
+                        self.path.rsplit("/", 1)[1], req.get("model")
+                    )
+                    self._json(200 if ok else 502,
+                               {"ok": ok, "replicas": detail})
+                    return
+                if self.path != "/predict":
+                    self._json(404, {"error": f"unknown path {self.path}"})
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(n) or b"{}")
+                    rows = req.get("rows")
+                    if rows is None:
+                        feats = req.get("features")
+                        if feats is None:
+                            raise ValueError(
+                                'request needs "features" or "rows"')
+                        rows = [feats]
+                    if not isinstance(rows, list) or not all(
+                        isinstance(r, dict) for r in rows
+                    ):
+                        raise ValueError('"rows" must be a list of objects')
+                except (ValueError, json.JSONDecodeError) as e:
+                    self._json(400, {"error": str(e), "type": "bad_request"})
+                    return
+                with obs_span("serve.front.request", rows=len(rows)):
+                    try:
+                        out = front.predict(
+                            rows, model=req.get("model"),
+                            deadline_ms=req.get("deadline_ms"),
+                        )
+                    except OverloadError as e:
+                        self._json(429, {"error": str(e), "type": "overload"})
+                        return
+                    except DeadlineExceeded as e:
+                        self._json(504, {"error": str(e), "type": "deadline"})
+                        return
+                    except ServeClosed as e:
+                        self._json(503, {"error": str(e), "type": "draining"})
+                        return
+                    except KeyError as e:
+                        self._json(404, {"error": str(e.args[0]),
+                                         "type": "unknown_model"})
+                        return
+                    except Exception as e:  # noqa: BLE001 — typed 500
+                        obs_inc("serve.front.request_errors")
+                        log.exception("front predict failed")
+                        self._json(500, {"error": f"{type(e).__name__}: {e}",
+                                         "type": "internal"})
+                        return
+                self._json(200, out)
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._serve_thread = threading.Thread(
+            target=self._httpd.serve_forever, name="ytk-fleet-http",
+            kwargs={"poll_interval": 0.1}, daemon=True,
+        )
+        self._serve_thread.start()
+        log.info("fleet: front listening on %s:%d (%d replicas)",
+                 self.host, self.port, self.n_replicas)
+        return self
+
+    def install_signal_handlers(self) -> None:
+        """SIGTERM/SIGINT -> graceful fleet drain (front stops intake,
+        forwarders flush, replicas drain their own queues)."""
+
+        def _drain(signum, frame):
+            log.info("fleet: signal %d, draining", signum)
+            threading.Thread(
+                target=self.stop, kwargs={"drain": True}, daemon=True
+            ).start()
+
+        signal.signal(signal.SIGTERM, _drain)
+        signal.signal(signal.SIGINT, _drain)

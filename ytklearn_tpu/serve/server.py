@@ -32,15 +32,18 @@ from __future__ import annotations
 import collections
 import json
 import logging
+import os
 import signal
 import threading
 import time
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
 
 import numpy as np
 
 from ..obs import inc as obs_inc, snapshot as obs_snapshot, span as obs_span
+from ..resilience import chaos_point
 from .batcher import (
     BatchPolicy,
     DeadlineExceeded,
@@ -48,6 +51,8 @@ from .batcher import (
     OverloadError,
     ServeClosed,
 )
+from .fleet.aimd import maybe_controller
+from .fleet.cache import maybe_cache
 from .registry import ModelRegistry, NoPreviousVersion
 
 log = logging.getLogger("ytklearn_tpu.serve")
@@ -64,19 +69,21 @@ class _LatencyWindow:
         with self._lock:
             self._ring.append(ms)
 
+    def raw(self) -> list:
+        """The ring itself (ms floats) — the fleet front unions replica
+        rings so fleet p99 is computed over every replica's samples, not
+        replica-0's (a per-replica percentile cannot be averaged)."""
+        with self._lock:
+            return list(self._ring)
+
     def percentiles(self) -> Dict[str, float]:
+        # one percentile implementation serves both the per-process ring
+        # and the fleet ring union — the payloads must never diverge
+        from .fleet.front import latency_percentiles
+
         with self._lock:
             vals = list(self._ring)
-        if not vals:
-            return {"count": 0}
-        arr = np.asarray(vals)
-        return {
-            "count": len(vals),
-            "p50_ms": round(float(np.percentile(arr, 50)), 3),
-            "p99_ms": round(float(np.percentile(arr, 99)), 3),
-            "p999_ms": round(float(np.percentile(arr, 99.9)), 3),
-            "max_ms": round(float(arr.max()), 3),
-        }
+        return latency_percentiles(vals)
 
 
 class ServeApp:
@@ -88,11 +95,22 @@ class ServeApp:
         policy: Optional[BatchPolicy] = None,
         host: str = "127.0.0.1",
         port: int = 0,
+        slo_ms: Optional[float] = None,
+        cache_rows: Optional[int] = None,
+        replica_id: Optional[int] = None,
     ):
         self.registry = registry
         self.policy = policy or BatchPolicy()
         self.host = host
         self.port = port
+        # slo_ms > 0 arms the AIMD batch-size controller per batcher
+        # (serve/fleet/aimd.py); None/0 keeps the fixed policy knobs
+        self.slo_ms = slo_ms
+        # cache_rows > 0 arms the LRU prediction cache (serve/fleet/cache.py)
+        self.cache = maybe_cache(cache_rows if cache_rows is not None else 0)
+        # fleet identity: stamped into /metrics so the front (and a
+        # postmortem) can name this replica; None = solo process
+        self.replica_id = replica_id
         self.latency = _LatencyWindow()
         self.draining = False
         self._batchers: Dict[str, MicroBatcher] = {}
@@ -115,7 +133,14 @@ class ServeApp:
                     scores, preds = entry.scorer.score_and_predict(rows)
                     return scores, preds, entry  # entry = version of record
 
-                b = MicroBatcher(score_fn, self.policy)
+                controller = None
+                if self.slo_ms and self.slo_ms > 0:
+                    # AIMD searches over THIS model's compiled ladder, so
+                    # every size it picks is already warm (no retrace)
+                    controller = maybe_controller(
+                        self.registry.get(name).scorer.ladder, self.slo_ms
+                    )
+                b = MicroBatcher(score_fn, self.policy, controller=controller)
                 self._batchers[name] = b
             return b
 
@@ -128,8 +153,29 @@ class ServeApp:
         if not names:
             raise KeyError("no models loaded")
         name = model or names[0]
-        self.registry.get(name)  # 404 before enqueue for bad names
+        entry = self.registry.get(name)  # 404 before enqueue for bad names
+        # fleet restart drill: kind=kill here takes this replica down
+        # mid-request, exactly like a hardware loss under load
+        chaos_point("serve.worker")
         t0 = time.perf_counter()
+        cache = self.cache
+        if cache is not None:
+            hit = cache.lookup(cache.model_key(entry), rows)
+            if hit is not None:
+                # every row of this request was scored before by the
+                # CURRENT entry: bypass the queue entirely (no batcher,
+                # no scorer) — the stored values ARE the scored path's
+                # outputs, so the response is bit-identical to a cold one
+                self.latency.record((time.perf_counter() - t0) * 1e3)
+                obs_inc("serve.requests")
+                obs_inc("serve.request_rows", len(rows))
+                return {
+                    "model": name,
+                    "version": entry.version,
+                    "cached": True,
+                    "scores": np.asarray([h[0] for h in hit]).tolist(),
+                    "predictions": np.asarray([h[1] for h in hit]).tolist(),
+                }
         pending = self.batcher_for(name).submit(rows, deadline_ms=deadline_ms)
         scores, preds = pending.get(timeout)
         self.latency.record((time.perf_counter() - t0) * 1e3)
@@ -139,6 +185,10 @@ class ServeApp:
         # must name the model that actually scored it, not whatever was
         # current at enqueue time (hot-reload race)
         entry = pending.meta or self.registry.get(name)
+        if cache is not None:
+            # keyed by the entry that ACTUALLY scored the batch: a swap
+            # landing between submit and score must not mislabel rows
+            cache.store(cache.model_key(entry), rows, scores, preds)
         return {
             "model": name,
             "version": entry.version,
@@ -172,13 +222,30 @@ class ServeApp:
             },
         }
 
-    def metrics_payload(self) -> dict:
+    def metrics_payload(self, raw: bool = False) -> dict:
         snap = obs_snapshot()
         with self._batchers_lock:  # batcher_for inserts concurrently
             batchers = dict(self._batchers)
-        return {
-            "latency": self.latency.percentiles(),
+        latency = self.latency.percentiles()
+        if raw:
+            # the fleet front merges replica rings (union, then one
+            # percentile pass) — fleet p99 must be a fleet number
+            latency["raw_ms"] = [round(v, 3) for v in self.latency.raw()]
+        out = {
+            # identity rides every metrics scrape so the front's fleet
+            # table (and a postmortem diffing scrapes) names the replica
+            "replica": {"replica_id": self.replica_id, "pid": os.getpid()},
+            "latency": latency,
             "queue_depth": {n: b.queue_depth for n, b in batchers.items()},
+            "batching": {
+                n: (
+                    b.controller.snapshot()
+                    if b.controller is not None
+                    else {"max_batch": self.policy.max_batch,
+                          "max_wait_ms": self.policy.max_wait_ms}
+                )
+                for n, b in batchers.items()
+            },
             "models": {
                 n: {
                     "version": self.registry.get(n).version,
@@ -190,6 +257,10 @@ class ServeApp:
             "counters": {k: round(v, 3) for k, v in sorted(snap["counters"].items())},
             "gauges": {k: round(v, 4) for k, v in sorted(snap["gauges"].items())},
         }
+        if self.cache is not None:
+            out["cache"] = {"rows": len(self.cache),
+                            "max_rows": self.cache.max_rows}
+        return out
 
     # -- lifecycle --------------------------------------------------------
 
@@ -245,16 +316,20 @@ class ServeApp:
                     self._json(400, {"error": str(e), "type": "bad_request"})
 
             def do_GET(self):  # noqa: N802 — stdlib handler API
-                if self.path == "/healthz":
+                split = urllib.parse.urlsplit(self.path)
+                path = split.path
+                query = urllib.parse.parse_qs(split.query)
+                if path == "/healthz":
                     self._json(200, app.health_payload())
-                elif self.path == "/readyz":
+                elif path == "/readyz":
                     ok = app.ready()
                     self._json(200 if ok else 503,
                                {"ready": ok,
                                 "status": "draining" if app.draining else
                                 ("ok" if ok else "no models")})
-                elif self.path == "/metrics":
-                    self._json(200, app.metrics_payload())
+                elif path == "/metrics":
+                    raw = query.get("raw", ["0"])[0] not in ("0", "")
+                    self._json(200, app.metrics_payload(raw=raw))
                 else:
                     self._json(404, {"error": f"unknown path {self.path}"})
 
