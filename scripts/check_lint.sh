@@ -1,22 +1,88 @@
 #!/usr/bin/env bash
-# Static pass: ytklint (the project's JAX/TPU-aware AST rules — see
-# docs/static_analysis.md) over the library, scripts, and bench.py, plus
-# the knob-registry <-> running-guide doc-sync check (both directions).
-# Runs in well under a second; wired into the tier-1 verify recipe next to
-# check_no_print.sh (now a delegating wrapper), check_suite_time.sh and
-# check_bench_regress.py (ROADMAP.md).
+# Umbrella static-guard runner. A full (no-arg) invocation runs EVERY
+# guard to completion — ytklint rules (docs/static_analysis.md), the
+# knob-registry <-> running-guide doc-sync check, and the bench
+# regression gate — then reports all failures with per-check timing,
+# instead of stopping at the first failed check (a postmortem needs the
+# whole picture, not the first symptom). The 40-minute full-suite wall
+# guard joins the run with --suite (it executes the entire test suite,
+# so it is opt-in here and still runs standalone in CI).
 #
-# Usage: scripts/check_lint.sh [ytklint args…]
-#   scripts/check_lint.sh                        # full repo pass
-#   scripts/check_lint.sh --select bare-print ytklearn_tpu
-#   scripts/check_lint.sh --list-rules
+# Usage:
+#   scripts/check_lint.sh                    # rules + doc-sync + bench-regress
+#   scripts/check_lint.sh --suite            # + check_suite_time.sh (slow!)
+#   scripts/check_lint.sh --json lint.json   # also write the machine-readable
+#                                            # lint artifact (schema "ytklint";
+#                                            # scripts/obs_report.py renders it)
+#   scripts/check_lint.sh [ytklint args…]    # passthrough: one lint invocation
+#       e.g. scripts/check_lint.sh --select bare-print ytklearn_tpu
+#            (how check_no_print.sh delegates)  /  --list-rules
 set -o pipefail
 cd "$(dirname "$0")/.."
 
-rc=0
-python -m tools.ytklint "$@" || rc=1
-# the doc-sync half only makes sense on a full default run
-if [ "$#" -eq 0 ]; then
-    python -m ytklearn_tpu.config.knobs check docs/running_guide.md || rc=1
+WITH_SUITE=0
+JSON_OUT=""
+PASSTHRU=()
+while [ $# -gt 0 ]; do
+    case "$1" in
+        --suite) WITH_SUITE=1 ;;
+        --json) JSON_OUT="$2"; shift ;;
+        *) PASSTHRU+=("$1") ;;
+    esac
+    shift
+done
+
+# arg passthrough: a scoped/select invocation is a single lint run, not
+# the umbrella (check_no_print.sh and ad-hoc --select calls ride this)
+if [ ${#PASSTHRU[@]} -gt 0 ]; then
+    exec python -m tools.ytklint "${PASSTHRU[@]}"
 fi
-exit $rc
+
+NAMES=()
+RCS=()
+SECS=()
+
+run_check() {
+    local name="$1"; shift
+    local t0 t1 rc
+    t0=$(date +%s.%N)
+    "$@"
+    rc=$?
+    t1=$(date +%s.%N)
+    NAMES+=("$name")
+    RCS+=("$rc")
+    SECS+=("$(awk -v a="$t0" -v b="$t1" 'BEGIN{printf "%.2f", b-a}')")
+}
+
+# with --json the single rules run IS the artifact writer (same exit
+# semantics, and the dominant cost of the umbrella is not paid twice)
+if [ -n "$JSON_OUT" ]; then
+    run_check "ytklint-rules" sh -c \
+        "python -m tools.ytklint --format json > '$JSON_OUT'"
+else
+    run_check "ytklint-rules" python -m tools.ytklint
+fi
+run_check "knob-doc-sync"  python -m ytklearn_tpu.config.knobs check docs/running_guide.md
+run_check "bench-regress"  python scripts/check_bench_regress.py
+if [ "$WITH_SUITE" -eq 1 ]; then
+    run_check "suite-time" scripts/check_suite_time.sh
+else
+    echo "suite-time: skipped (run scripts/check_lint.sh --suite, or" \
+         "scripts/check_suite_time.sh standalone — it executes the full" \
+         "test suite under the 40-min budget)"
+fi
+
+overall=0
+echo
+echo "-- static guards ------------------------------------------------"
+for i in "${!NAMES[@]}"; do
+    if [ "${RCS[$i]}" -eq 0 ]; then
+        status="ok  "
+    else
+        status="FAIL"
+        overall=1
+    fi
+    printf '  %s  %-20s %8ss  (rc=%s)\n' \
+        "$status" "${NAMES[$i]}" "${SECS[$i]}" "${RCS[$i]}"
+done
+exit $overall

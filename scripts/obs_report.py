@@ -9,6 +9,7 @@ telemetry, and the collective census.
     python scripts/obs_report.py /tmp/trace.json        # YTK_TRACE output
     python scripts/obs_report.py /tmp/events.jsonl      # YTK_TRACE_JSONL
     python scripts/obs_report.py BENCH_r05.json         # bench artifact
+    python scripts/obs_report.py lint.json              # ytklint --format json
 
 Input kind is sniffed, not flagged:
   flight dump   JSON object with a "flight" block (obs/recorder.py)
@@ -18,6 +19,10 @@ Input kind is sniffed, not flagged:
                 wrapper's "parsed")
   fleet metrics a FleetFront /metrics snapshot ("fleet" + "replicas"
                 keys) — rendered as a per-replica fleet table
+  lint report   `ytklint --format json` / `check_lint.sh --json` output
+                (schema "ytklint") — findings per rule plus the live
+                reasoned-suppression inventory, so CI annotations and
+                postmortems share one artifact
 
 Fleet postmortems: any artifact whose counters/events carry
 serve.worker.* / serve.front.* evidence gets a "serving fleet" section,
@@ -93,6 +98,15 @@ def _load(path: str) -> Tuple[str, dict]:
             "gauges": {},
             "flight": None,
             "bench": None,
+        }
+    if doc.get("schema") == "ytklint":
+        return "lint-report", {
+            "events": [],
+            "counters": {},
+            "gauges": {},
+            "flight": None,
+            "bench": None,
+            "lint": doc,
         }
     if "fleet" in doc and "replicas" in doc and "metric" not in doc:
         # a FleetFront /metrics snapshot saved to a file
@@ -211,6 +225,32 @@ def report(path: str) -> None:
                     f"versions={mixed.get('versions_seen')} "
                     f"reloads={mixed.get('reloads_fleet')}"
                 )
+
+    lint = data.get("lint")
+    if lint:
+        findings = lint.get("findings") or []
+        suppressed = lint.get("suppressed") or []
+        _section("static analysis (ytklint)")
+        print(f"  rules: {len(lint.get('rules') or [])}  "
+              f"files: {lint.get('files')}  findings: {len(findings)}  "
+              f"reasoned suppressions: {len(suppressed)}")
+        per_rule: Dict[str, int] = defaultdict(int)
+        for f_ in findings:
+            per_rule[f_.get("rule", "?")] += 1
+        for rule_name, n in sorted(per_rule.items(), key=lambda kv: -kv[1]):
+            print(f"  {rule_name:<28s} {n}")
+        for f_ in findings[:20]:
+            print(f"  {f_.get('path')}:{f_.get('line')}: "
+                  f"[{f_.get('rule')}] {f_.get('message', '')[:90]}")
+        if len(findings) > 20:
+            print(f"  ... {len(findings) - 20} more finding(s)")
+        if suppressed:
+            _section("suppression inventory (each verified live by the "
+                     "unused-suppression audit)")
+            for s in suppressed:
+                print(f"  {s.get('path')}:{s.get('line')}: "
+                      f"[{s.get('rule')}] reason={s.get('reason', '')[:80]}")
+        return  # a lint artifact carries no runtime evidence sections
 
     fm = data.get("fleet_metrics")
     if fm:

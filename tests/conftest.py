@@ -42,6 +42,17 @@ def pytest_addoption(parser):
         "hot paths perform no implicit host<->device transfer and produce "
         "no NaNs (docs/static_analysis.md, 'Runtime sanitizer mode')",
     )
+    parser.addoption(
+        "--ytk-lockwatch",
+        action="store_true",
+        default=False,
+        help="run @pytest.mark.threaded tests with threading.Lock/RLock "
+        "monkey-wrapped: per-thread held-lock stacks with acquisition "
+        "sites, a global acquisition-order graph that fails the test on "
+        "any observed lock-order cycle, and a hold-time budget "
+        "(YTK_LOCKWATCH_HOLD_MS) — the runtime twin of the ytklint "
+        "concurrency rules (docs/static_analysis.md)",
+    )
 
 
 def pytest_configure(config):
@@ -57,6 +68,14 @@ def pytest_configure(config):
         "slow: excluded from the tier-1 `-m 'not slow'` run (870s wall "
         "guard); still covered by the full suite under "
         "scripts/check_suite_time.sh's 40-minute budget",
+    )
+    config.addinivalue_line(
+        "markers",
+        "threaded(subsystem): marks a genuinely multi-threaded test "
+        "(fleet kill-9 hammer, batcher drain, registry hot reload, "
+        "retrain-lock heartbeat); under --ytk-lockwatch it runs with "
+        "instrumented locks — the runtime pin of the ytklint "
+        "lock-order / hold-time rules",
     )
 
 
@@ -79,6 +98,35 @@ def _ytk_sanitizer(request):
             yield
     finally:
         jax.config.update("jax_debug_nans", prev_nans)
+
+
+@pytest.fixture(autouse=True)
+def _ytk_lockwatch(request):
+    """With --ytk-lockwatch, watch every lock a threaded-marked test
+    creates. Staging mirrors the sanitizer: module-scoped fixtures (and
+    their locks) build BEFORE this function-scoped fixture, so the watch
+    covers exactly what the test body constructs and drives."""
+    if not (
+        request.config.getoption("--ytk-lockwatch")
+        and request.node.get_closest_marker("threaded")
+    ):
+        yield
+        return
+    from tools.ytklint.lockwatch import LockWatch
+
+    watch = LockWatch()
+    watch.install()
+    try:
+        yield
+    finally:
+        watch.uninstall()
+    violations = watch.report()
+    if violations:
+        pytest.fail(
+            "ytk-lockwatch: %d violation(s) observed:\n  %s"
+            % (len(violations), "\n  ".join(violations)),
+            pytrace=False,
+        )
 
 
 @pytest.fixture(scope="session")

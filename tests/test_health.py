@@ -525,3 +525,53 @@ def test_read_bench_record_unwraps_driver_shape(tmp_path):
     p2.write_text(json.dumps({"n": 10, "cmd": "c", "rc": 1, "parsed": None}))
     rec2 = read_bench_record(str(p2))
     assert rec2["trees_per_sec"] is None and rec2["health_events"] == 0
+
+
+def test_crash_flags_and_dump_path_are_lockless(tmp_path, monkeypatch):
+    """Regression (r15 concurrency pass): the crash-path state
+    (`_state.abnormal`, `_state.last_dump_path`, the dump itself) is
+    deliberately lockless — a signal handler or excepthook that took
+    `_install_lock` would deadlock the moment the interrupted thread
+    held it. uninstall() used to reset those flags INSIDE the install
+    lock, which made them look lock-guarded when the lock never
+    protected them (ytklint `unguarded-shared-write`). Pin: a dump fired
+    while another thread holds `_install_lock` completes immediately."""
+    monkeypatch.setenv("YTK_FLIGHT_DIR", str(tmp_path))
+    obs.configure(enabled=True)
+    try:
+        acquired = threading.Event()
+        release = threading.Event()
+
+        def holder():
+            with recorder._install_lock:
+                acquired.set()
+                release.wait(timeout=30.0)
+
+        t = threading.Thread(target=holder, daemon=True)
+        t.start()
+        assert acquired.wait(timeout=10.0)
+        done = []
+
+        def dumper():
+            done.append(recorder.dump("lockless-pin"))
+
+        d = threading.Thread(target=dumper, daemon=True)
+        try:
+            d.start()
+            d.join(timeout=5.0)
+            assert done and done[0], (
+                "dump() blocked on _install_lock — the crash path must "
+                "never take it"
+            )
+            assert os.path.exists(done[0])
+            assert recorder.last_dump_path() == done[0]
+        finally:
+            release.set()
+            t.join(timeout=10.0)
+        # uninstall resets the flags without needing the lock either
+        recorder.uninstall()
+        assert recorder.last_dump_path() is None
+        assert not recorder._state.abnormal
+    finally:
+        obs.configure(enabled=False)
+        obs.reset()

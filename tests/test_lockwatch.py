@@ -1,0 +1,177 @@
+"""Lockwatch meta-tests: the runtime twin must actually bite.
+
+The --ytk-sanitize precedent: a guard that is never seen to fail is a
+guard you cannot trust. These tests drive tools/ytklint/lockwatch.py's
+machinery directly (no pytest flag needed, so they run in tier-1) and
+prove a planted lock-order inversion and a planted over-budget hold are
+both reported, while the repo's real locking idioms (condition waits,
+RLock re-entry, plain nesting in one consistent order) stay clean.
+"""
+
+import threading
+import time
+
+import pytest
+
+from tools.ytklint.lockwatch import LockWatch, WatchedLock
+
+
+@pytest.fixture()
+def watch():
+    w = LockWatch(hold_ms=10_000.0)
+    w.install()
+    try:
+        yield w
+    finally:
+        w.uninstall()
+    # uninstall must restore the real factories for the rest of the suite
+    assert threading.Lock.__module__ == "_thread" or not isinstance(
+        threading.Lock(), WatchedLock
+    )
+
+
+def test_planted_inversion_fails_loud(watch):
+    """The acceptance plant: A->B in one order, B->A in the other —
+    caught even though the two orders run sequentially (the graph
+    remembers), which is exactly why the watch sees the r14 bug class
+    without needing a lucky interleaving."""
+    a = threading.Lock()
+    b = threading.Lock()
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    violations = watch.report()
+    assert len(violations) == 1
+    assert "lock-order inversion" in violations[0]
+    # both acquisition sites are named for the postmortem
+    assert violations[0].count("test_lockwatch.py") >= 2
+
+
+def test_inversion_reported_once_per_cycle(watch):
+    """Review fix: re-exercising one A->B/B->A inversion in a hammer
+    loop must not re-append the violation on every acquire — the cycle
+    check runs only on NEW edges (any new cycle contains one)."""
+    a = threading.Lock()
+    b = threading.Lock()
+    for _ in range(5):
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+    assert len(watch.report()) == 1
+
+
+def test_inversion_across_threads(watch):
+    """Same plant, two real threads: the violating order is recorded by
+    whichever thread exercises it second."""
+    a = threading.Lock()
+    b = threading.Lock()
+    done = threading.Event()
+
+    def t1():
+        with a:
+            with b:
+                pass
+        done.set()
+
+    th = threading.Thread(target=t1)
+    th.start()
+    th.join(timeout=5.0)
+    assert done.is_set()
+    with b:
+        with a:
+            pass
+    assert any("lock-order inversion" in v for v in watch.report())
+
+
+def test_hold_budget_bites():
+    w = LockWatch(hold_ms=20.0)
+    w.install()
+    try:
+        lock = threading.Lock()
+        with lock:
+            time.sleep(0.06)
+    finally:
+        w.uninstall()
+    violations = w.report()
+    assert len(violations) == 1
+    assert "hold over budget" in violations[0]
+    assert "YTK_LOCKWATCH_HOLD_MS" in violations[0]
+
+
+def test_hold_budget_reads_knob(monkeypatch):
+    monkeypatch.setenv("YTK_LOCKWATCH_HOLD_MS", "17.5")
+    assert LockWatch().hold_ms == 17.5
+    monkeypatch.delenv("YTK_LOCKWATCH_HOLD_MS")
+    assert LockWatch().hold_ms == 1000.0  # the declared default
+
+
+def test_condition_wait_is_not_a_hold(watch):
+    """Condition.wait releases the underlying lock — a consumer parked
+    in wait() for longer than any budget must stay clean (the batcher
+    linger idiom)."""
+    watch.hold_ms = 30.0
+    cond = threading.Condition(threading.Lock())
+    items = []
+
+    def consumer():
+        with cond:
+            while not items:
+                cond.wait(timeout=1.0)
+
+    th = threading.Thread(target=consumer)
+    th.start()
+    time.sleep(0.15)  # parked well past the 30ms budget
+    with cond:
+        items.append(1)
+        cond.notify()
+    th.join(timeout=5.0)
+    assert watch.report() == []
+
+
+def test_rlock_reentry_is_not_an_edge(watch):
+    """RLock re-entry must create neither a self-edge nor a second hold
+    (the obs registry uses re-entrant patterns under one lock)."""
+    r = threading.RLock()
+    with r:
+        with r:
+            pass
+    assert watch.report() == []
+
+
+def test_consistent_order_stays_clean(watch):
+    """A->B taken in the same order from two threads is NOT an
+    inversion."""
+    a = threading.Lock()
+    b = threading.Lock()
+
+    def worker():
+        with a:
+            with b:
+                pass
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=5.0)
+    with a:
+        with b:
+            pass
+    assert watch.report() == []
+
+
+def test_uninstall_restores_real_locks():
+    w = LockWatch()
+    w.install()
+    assert isinstance(threading.Lock(), WatchedLock)
+    w.uninstall()
+    lk = threading.Lock()
+    assert not isinstance(lk, WatchedLock)
+    with lk:
+        pass

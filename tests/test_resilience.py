@@ -597,6 +597,7 @@ def test_retrain_lock_release_respects_foreign_owner(tmp_path):
     assert json.load(open(path))["pid"] == os.getpid() + 1
 
 
+@pytest.mark.threaded
 def test_retrain_lock_heartbeat_advances(tmp_path):
     from ytklearn_tpu.continual import RetrainLock
     from ytklearn_tpu.io.fs import LocalFileSystem

@@ -215,6 +215,7 @@ def test_batcher_deadline_expired():
         b.close(drain=True)
 
 
+@pytest.mark.threaded
 def test_batcher_drain_completes_queued_work():
     done = []
 
@@ -327,6 +328,7 @@ def _write_linear_model(path, weight: float):
     path.write_text(f"c0,{weight:.6f},1.0\n_bias_,0.0\n")
 
 
+@pytest.mark.threaded
 def test_hot_reload_swaps_atomically_mid_traffic(tmp_path):
     from ytklearn_tpu.config import hocon  # noqa: F401 — config is a plain dict
 

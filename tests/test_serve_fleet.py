@@ -279,6 +279,7 @@ def test_front_routes_scores_and_balances(obs_on):
         front.stop(drain=True, timeout=15.0)
 
 
+@pytest.mark.threaded
 def test_front_kill9_reroutes_with_zero_failures_and_restarts(obs_on):
     """The fleet acceptance drill in miniature: kill -9 one replica under
     load; every in-flight request still completes (rerouted), and the
@@ -504,3 +505,48 @@ def test_cli_serve_fleet_subprocess(tmp_path):
         if proc.poll() is None:
             proc.kill()
             proc.wait(timeout=10.0)
+
+
+@pytest.mark.threaded
+def test_stop_joins_respawns_while_monitor_inserts(monkeypatch):
+    """Regression (r15 concurrency pass): the monitor thread publishes
+    async-respawn threads into `front._respawns` while stop() sweeps the
+    dict to join them — unsynchronized, an insert landing mid-iteration
+    raised "dictionary changed size during iteration", aborting the
+    drain and orphaning the freshly-spawned worker. Both sides now hold
+    `_respawns_lock` (the ytklint `unguarded-shared-write` finding that
+    motivated the rule's Thread(target=) escape analysis)."""
+    from ytklearn_tpu.serve.fleet.worker import ReplicaHandle
+
+    monkeypatch.setattr(
+        FleetFront, "_do_restart", lambda self, rid, h: time.sleep(0.002)
+    )
+    front = _stub_front(replicas=1)  # never started: no real workers
+    failures = []
+    stop_churn = threading.Event()
+
+    def churn():
+        rid = 0
+        while not stop_churn.is_set() and rid < 5000:
+            h = ReplicaHandle(rid)
+            h.state = "dead"
+            try:
+                front._maybe_restart(rid, h)
+            except Exception as e:  # noqa: BLE001 — collected for the assert
+                failures.append(e)
+            rid += 1
+
+    t = threading.Thread(target=churn)
+    t.start()
+    time.sleep(0.05)  # churn provably running before the sweep starts
+    try:
+        front.stop(drain=True, timeout=2.0)  # joins _respawns concurrently
+    finally:
+        stop_churn.set()
+        t.join(timeout=20.0)
+    assert not failures, failures[:3]
+    with front._respawns_lock:
+        respawns = list(front._respawns.values())
+    for rt in respawns:
+        rt.join(timeout=5.0)
+    assert not any(rt.is_alive() for rt in respawns)

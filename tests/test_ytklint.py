@@ -11,7 +11,15 @@ import textwrap
 
 import pytest
 
-from tools.ytklint import RULES, lint_paths, lint_source
+from tools.ytklint import (
+    RULES,
+    RULE_ALIASES,
+    lint_paths,
+    lint_paths_report,
+    lint_source,
+    lint_source_report,
+    report_json,
+)
 from ytklearn_tpu.config import knobs
 
 
@@ -31,10 +39,17 @@ def test_rule_catalog_is_the_issue_catalog():
         "broad-except-swallow",
         "bare-print",
         "sleep-in-except",
-        "serve-lock-discipline",
+        # the r15 concurrency pass (tools/ytklint/concurrency.py)
+        "unguarded-shared-write",
+        "lock-order-inversion",
+        "blocking-call-under-lock",
+        "thread-lifecycle",
     }
     for r in RULES.values():
         assert r.doc  # every rule documents itself for --list-rules
+    # serve-lock-discipline graduated into unguarded-shared-write; the
+    # alias keeps old suppressions/--select invocations valid
+    assert RULE_ALIASES["serve-lock-discipline"] == "unguarded-shared-write"
 
 
 # ---------------------------------------------------------------------------
@@ -285,7 +300,7 @@ def test_bare_print_suppression():
 
 
 # ---------------------------------------------------------------------------
-# serve-lock-discipline
+# unguarded-shared-write (subsumes serve-lock-discipline)
 # ---------------------------------------------------------------------------
 
 _LOCKED_CLASS = """\
@@ -368,22 +383,448 @@ def test_sleep_in_except_suppression():
     assert run(src, select=["sleep-in-except"]) == []
 
 
-def test_serve_lock_discipline_fails():
+def test_unguarded_shared_write_fails():
     src = _LOCKED_CLASS.format(reset_body="self.depth = 0  # no lock!")
     found = lint_source(src, "ytklearn_tpu/serve/q.py")
-    assert {f.rule for f in found} == {"serve-lock-discipline"}
+    assert {f.rule for f in found} == {"unguarded-shared-write"}
 
 
-def test_serve_lock_discipline_passes_under_lock():
+def test_unguarded_shared_write_passes_under_lock():
     src = _LOCKED_CLASS.format(
         reset_body="with self._lock:\n            self.depth = 0"
     )
     assert lint_source(src, "ytklearn_tpu/serve/q.py") == []
 
 
-def test_serve_lock_discipline_scoped_to_serve():
+def test_unguarded_shared_write_is_repo_wide_now():
+    """The r10 rule stopped at serve/; the concurrency pass covers every
+    package (the retrain-lock heartbeat and obs recorder live outside
+    serve/ and are just as threaded)."""
     src = _LOCKED_CLASS.format(reset_body="self.depth = 0")
-    assert lint_source(src, "ytklearn_tpu/gbdt/q.py") == []
+    found = lint_source(src, "ytklearn_tpu/gbdt/q.py")
+    assert {f.rule for f in found} == {"unguarded-shared-write"}
+
+
+def test_unguarded_shared_write_r14_inflight_rmw_plant():
+    """The acceptance plant: the exact r14 `_inflight` bug — a lockless
+    dict read-modify-write in one method while every other mutation of
+    the same attr holds the lock (the lost update skewed least-queued
+    balancing forever)."""
+    src = """\
+    import threading
+
+    class Front:
+        def __init__(self):
+            self._inflight_lock = threading.Lock()
+            self._inflight = {}
+
+        def _post(self, rid, rows):
+            with self._inflight_lock:
+                self._inflight[rid] = self._inflight.get(rid, 0) + len(rows)
+
+        def _done(self, rid, rows):
+            self._inflight[rid] = self._inflight.get(rid, 0) - len(rows)
+    """
+    found = run(src)
+    assert [f.rule for f in found] == ["unguarded-shared-write"]
+    assert "_inflight" in found[0].message and "_done" in found[0].message
+
+
+def test_unguarded_shared_write_module_global():
+    """Module-global state counts too: a `global` rebind (or a write to a
+    module-level singleton's attr) guarded in one function and lockless
+    in another."""
+    src = """\
+    import threading
+
+    _lock = threading.Lock()
+    _cache = None
+
+    def warm():
+        global _cache
+        with _lock:
+            _cache = build()
+
+    def poke():
+        global _cache
+        _cache = None
+    """
+    assert rules_hit(src) == {"unguarded-shared-write"}
+
+
+def test_unguarded_shared_write_thread_escape_iteration():
+    """The Thread(target=) escape: a dict mutated on a thread path while
+    another method iterates it with no common lock (the r15 _respawns
+    finding in the fleet front)."""
+    src = """\
+    import threading
+
+    class Fleet:
+        def __init__(self):
+            self.slots = {}
+            self._t = None
+
+        def start(self):
+            self._t = threading.Thread(target=self._monitor, daemon=True)
+            self._t.start()
+
+        def _monitor(self):
+            self.slots[0] = object()
+
+        def stop(self):
+            for s in list(self.slots.values()):
+                use(s)
+    """
+    found = run(src)
+    assert [f.rule for f in found] == ["unguarded-shared-write"]
+    assert "thread path" in found[0].message and "stop" in found[0].message
+
+
+def test_unguarded_shared_write_common_lock_passes():
+    src = """\
+    import threading
+
+    class Fleet:
+        def __init__(self):
+            self.slots = {}
+            self._lock = threading.Lock()
+            self._t = None
+
+        def start(self):
+            self._t = threading.Thread(target=self._monitor, daemon=True)
+            self._t.start()
+
+        def _monitor(self):
+            with self._lock:
+                self.slots[0] = object()
+
+        def stop(self):
+            with self._lock:
+                snap = list(self.slots.values())
+            for s in snap:
+                use(s)
+    """
+    assert run(src) == []
+
+
+def test_unguarded_shared_write_suppression_accepts_legacy_alias():
+    """Existing allow(serve-lock-discipline) comments keep suppressing
+    the successor rule (the check_no_print.sh wrapper precedent)."""
+    src = _LOCKED_CLASS.format(
+        reset_body="self.depth = 0  # ytklint: allow(serve-lock-discipline) reason=single-writer reset before worker start"
+    )
+    assert lint_source(src, "ytklearn_tpu/serve/q.py") == []
+
+
+# ---------------------------------------------------------------------------
+# lock-order-inversion
+# ---------------------------------------------------------------------------
+
+_TWO_LOCKS = """\
+import threading
+
+class Pair:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def one(self):
+        with self._a:
+            with self._b:
+                return 1
+
+    def two(self):
+        {two_body}
+"""
+
+
+def test_lock_order_inversion_plant_is_flagged():
+    """The acceptance plant: A->B in one method, B->A in another."""
+    src = _TWO_LOCKS.format(
+        two_body="with self._b:\n            with self._a:\n                return 2"
+    )
+    found = run(src)
+    assert {f.rule for f in found} == {"lock-order-inversion"}
+    # both acquisition sites are named (fix either to break the cycle)
+    assert len(found) == 2
+
+
+def test_lock_order_consistent_nesting_passes():
+    src = _TWO_LOCKS.format(
+        two_body="with self._a:\n            with self._b:\n                return 2"
+    )
+    assert run(src) == []
+
+
+def test_lock_order_inversion_through_a_call():
+    """One-level call propagation: holding A and calling a method that
+    takes B is an A->B edge even without lexical nesting."""
+    src = """\
+    import threading
+
+    class Pair:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+
+        def under_b(self):
+            with self._b:
+                return 1
+
+        def one(self):
+            with self._a:
+                return self.under_b()
+
+        def two(self):
+            with self._b:
+                with self._a:
+                    return 2
+    """
+    assert "lock-order-inversion" in rules_hit(src)
+
+
+def test_lock_order_inversion_suppression():
+    src = _TWO_LOCKS.format(
+        two_body=(
+            "with self._b:\n"
+            "            # ytklint: allow(lock-order-inversion) reason=fixture demonstrating suppression\n"
+            "            with self._a:\n"
+            "                return 2"
+        )
+    )
+    found = run(src)
+    # the suppressed side is silenced; the partner edge still reports
+    assert [f.rule for f in found] == ["lock-order-inversion"]
+
+
+# ---------------------------------------------------------------------------
+# blocking-call-under-lock
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "body",
+    [
+        "self.procs[rid].wait(timeout=10.0)",
+        "time.sleep(1.0)",
+        "self.worker.join(5.0)",
+        "urlopen('http://127.0.0.1:1/readyz')",
+        "subprocess.run(['cc'], check=True)",
+        "chaos_point('serve.load')",
+        "retry_call(fn, site='io.read')",
+    ],
+)
+def test_blocking_call_under_lock_fails(body):
+    src = f"""\
+    import subprocess, threading, time
+    from urllib.request import urlopen
+
+    class W:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.procs = {{}}
+            self.worker = None
+
+        def heal(self, rid, fn):
+            with self._lock:
+                {body}
+    """
+    assert "blocking-call-under-lock" in rules_hit(src)
+
+
+def test_blocking_join_with_variable_timeout_is_still_a_join():
+    """Review fix: `self.t.join(self.timeout)` — one variable positional
+    arg — must not be misread as str.join(iterable) when the receiver is
+    a known thread binding (the exact r14 respawn-bug shape)."""
+    src = """\
+    import threading
+
+    class M:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.timeout = 15.0
+            self.t = threading.Thread(target=work, daemon=True)
+
+        def stop(self):
+            with self._lock:
+                self.t.join(self.timeout)
+    """
+    assert "blocking-call-under-lock" in rules_hit(src)
+    # ...while a genuine str.join under a lock stays clean
+    src2 = """\
+    import threading
+
+    _lock = threading.Lock()
+
+    def render(parts):
+        with _lock:
+            return ",".join(parts) + "|".join(sorted(parts))
+    """
+    assert run(src2) == []
+
+
+def test_blocking_call_outside_lock_passes():
+    src = """\
+    import threading, time
+
+    class W:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.state = {}
+
+        def heal(self, rid, proc):
+            with self._lock:
+                self.state[rid] = "dead"
+            proc.wait(timeout=10.0)  # blocking AFTER the lock released
+            time.sleep(0.1)
+    """
+    assert run(src) == []
+
+
+def test_condition_wait_on_held_lock_is_not_blocking():
+    """Condition.wait on the HELD lock releases it — the batcher linger
+    idiom must stay clean."""
+    src = """\
+    import threading
+
+    class B:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._not_empty = threading.Condition(self._lock)
+            self._queue = []
+
+        def take(self):
+            with self._not_empty:
+                while not self._queue:
+                    self._not_empty.wait(timeout=0.05)
+                return self._queue.pop()
+    """
+    assert run(src) == []
+
+
+def test_blocking_call_one_level_propagation():
+    """The r14 respawn-bug shape: the blocking work hides one call away
+    (monitor held a conceptual lock across a spawn that compiled jax for
+    tens of seconds)."""
+    src = """\
+    import subprocess, threading
+
+    _lock = threading.Lock()
+
+    def _build():
+        subprocess.run(["cc", "native.c"], check=True)
+
+    def load():
+        with _lock:
+            _build()
+    """
+    found = run(src)
+    assert [f.rule for f in found] == ["blocking-call-under-lock"]
+    assert "_build" in found[0].message
+
+
+def test_blocking_call_under_lock_suppression():
+    src = """\
+    import subprocess, threading
+
+    _lock = threading.Lock()
+
+    def load():
+        with _lock:
+            # ytklint: allow(blocking-call-under-lock) reason=fixture: build serialization is the point
+            subprocess.run(["cc"], check=True)
+    """
+    assert run(src) == []
+
+
+# ---------------------------------------------------------------------------
+# thread-lifecycle
+# ---------------------------------------------------------------------------
+
+
+def test_thread_lifecycle_unjoined_nondaemon_fails():
+    src = """\
+    import threading
+
+    def fire():
+        threading.Thread(target=work).start()
+    """
+    assert rules_hit(src) == {"thread-lifecycle"}
+
+
+def test_thread_lifecycle_joined_or_daemon_passes():
+    src = """\
+    import threading
+
+    class App:
+        def __init__(self):
+            self._worker = threading.Thread(target=work)
+
+        def start(self):
+            self._worker.start()
+            threading.Thread(target=poll, daemon=True).start()
+
+        def stop(self):
+            self._worker.join(timeout=10.0)
+    """
+    assert run(src) == []
+
+
+def test_thread_lifecycle_list_sweep_join_passes():
+    """The chaos_drill idiom: a comprehension of threads joined by a
+    `for t in threads: t.join()` sweep."""
+    src = """\
+    import threading
+
+    def drill():
+        threads = [threading.Thread(target=work) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30.0)
+    """
+    assert run(src) == []
+
+
+def test_thread_lifecycle_untimed_event_wait_in_loop_fails():
+    src = """\
+    import threading
+
+    class App:
+        def __init__(self):
+            self._stop = threading.Event()
+
+        def loop(self):
+            while True:
+                self._stop.wait()
+    """
+    assert "thread-lifecycle" in rules_hit(src)
+
+
+def test_thread_lifecycle_timed_event_wait_passes():
+    src = """\
+    import threading
+
+    class App:
+        def __init__(self):
+            self._stop = threading.Event()
+
+        def loop(self):
+            while not self._stop.wait(0.25):
+                tick()
+    """
+    assert run(src) == []
+
+
+def test_thread_lifecycle_suppression():
+    src = """\
+    import threading
+
+    def fire():
+        # ytklint: allow(thread-lifecycle) reason=fixture: fire-and-forget by design
+        threading.Thread(target=work).start()
+    """
+    assert run(src) == []
 
 
 # ---------------------------------------------------------------------------
@@ -411,6 +852,89 @@ def test_suppression_only_covers_named_rule():
         return x.item() * time.time()  # ytklint: allow(host-sync-in-jit) reason=fixture
     """
     assert {f.rule for f in run(src)} == {"retrace-hazard"}
+
+
+def test_unused_suppression_is_flagged():
+    """The stale-suppression audit: a suppression whose rule no longer
+    fires on the covered line is itself a finding, so the inventory
+    cannot drift as code moves (this exact audit retired a dead
+    broad-except allow in gbdt/trainer.py)."""
+    src = """\
+    import logging
+    log = logging.getLogger(__name__)
+    try:
+        work()
+    # ytklint: allow(broad-except) reason=stale — the handler logs now
+    except Exception:
+        log.warning("failed")
+    """
+    found = run(src)
+    assert [f.rule for f in found] == ["unused-suppression"]
+    assert "allow(broad-except-swallow)" in found[0].message
+
+
+def test_unused_suppression_respects_select_scope():
+    """A --select run only audits the rules it actually ran: a
+    suppression for an unselected rule is not reported (check_no_print's
+    `--select bare-print` must not flag unrelated suppressions)."""
+    src = """\
+    x = 1  # ytklint: allow(retrace-hazard) reason=not audited under this select
+    print("x")
+    """
+    found = run(src, select=["bare-print"])
+    assert [f.rule for f in found] == ["bare-print"]
+    # ...but a full run audits it
+    assert "unused-suppression" in {f.rule for f in run(src)}
+
+
+def test_live_suppression_is_not_flagged_unused():
+    src = "print('x')  # ytklint: allow(bare-print) reason=fixture\n"
+    assert run(src) == []
+
+
+# ---------------------------------------------------------------------------
+# machine-readable output (--format json)
+# ---------------------------------------------------------------------------
+
+
+def test_json_report_carries_findings_and_suppression_inventory():
+    import json
+
+    src = textwrap.dedent("""\
+    print("loud")
+    print("quiet")  # ytklint: allow(bare-print) reason=demo inventory entry
+    """)
+    rep = lint_source_report(src, "ytklearn_tpu/x.py")
+    doc = report_json(
+        {"findings": rep.findings, "suppressed": rep.suppressed, "files": 1}
+    )
+    doc = json.loads(json.dumps(doc))  # must be JSON-serializable as-is
+    assert doc["schema"] == "ytklint"
+    assert set(doc["rules"]) == set(RULES)
+    assert [f["rule"] for f in doc["findings"]] == ["bare-print"]
+    assert doc["findings"][0]["line"] == 1
+    assert doc["findings"][0]["suppressed"] is False
+    (sup,) = doc["suppressed"]
+    assert sup["rule"] == "bare-print" and sup["line"] == 2
+    assert sup["reason"] == "demo inventory entry"
+
+
+def test_json_cli_shape(tmp_path):
+    import json
+    import pathlib
+    import subprocess
+    import sys as _sys
+
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    out = subprocess.run(
+        [_sys.executable, "-m", "tools.ytklint", "--format", "json",
+         "ytklearn_tpu/config"],
+        cwd=repo, capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    doc = json.loads(out.stdout)
+    assert doc["schema"] == "ytklint" and doc["files"] >= 3
+    assert doc["findings"] == []
 
 
 # ---------------------------------------------------------------------------

@@ -3,9 +3,12 @@
 The generic linters the ecosystem ships cannot see this repo's real
 hazards: a hidden host sync inside a jitted hot path, retrace bait in a
 traced closure, an undeclared YTK_* knob, a broad except that swallows a
-failure, a serve-class attribute mutated outside its lock. ytklint is a
-small AST framework (core.py) plus seven rules (rules.py) that encode
-exactly those invariants, with an inline suppression syntax:
+failure, a shared attribute mutated outside its lock, two locks taken in
+opposite orders on two thread paths. ytklint is a small AST framework
+(core.py) plus the per-file rules (rules.py) and the cross-method
+concurrency pass (concurrency.py: guarded-state map, lock-order graph,
+blocking-IO-under-lock, thread lifecycle — runtime twin: pytest
+--ytk-lockwatch, lockwatch.py), with an inline suppression syntax:
 
     # ytklint: allow(<rule>[, <rule>]) reason=<non-empty explanation>
 
@@ -18,8 +21,13 @@ rule: docs/static_analysis.md.
 from .core import (  # noqa: F401
     Finding,
     RULES,
+    RULE_ALIASES,
     lint_paths,
+    lint_paths_report,
     lint_source,
+    lint_source_report,
     main,
+    report_json,
 )
 from . import rules  # noqa: F401  — importing registers the rule set
+from . import concurrency  # noqa: F401  — registers the concurrency rules

@@ -1,0 +1,851 @@
+"""Whole-module concurrency analysis: guarded-state map + lock-order graph.
+
+r12-r14 made the repo genuinely concurrent (forwarder MicroBatchers, a
+fleet monitor, async respawns, registry watchers, retrain-lock
+heartbeats, signal handlers, concurrent /metrics scrapes) and the two
+worst r14 bugs — the lockless ``_inflight`` read-modify-write that
+permanently skewed balancing, and the monitor thread blocked for tens of
+seconds inside a synchronous respawn — were caught only by hand review.
+This module is the mechanical version of that review: one cross-method,
+cross-class pass per file that builds
+
+  (a) a **guarded-state map** — which ``self.`` attributes and
+      module-global objects are written while holding which
+      ``threading.Lock``/``RLock``/``Condition`` (a Condition constructed
+      over a lock aliases it: guarding state under the condition IS
+      guarding it under the lock), and
+
+  (b) a **static lock-acquisition-order graph** — an edge A→B whenever a
+      ``with B`` begins while A is held (lexically nested, or one level
+      through a same-module call), with thread entry points
+      (``Thread(target=...)``, ``signal.signal`` handlers,
+      ``BaseHTTPRequestHandler`` subclasses) resolved so escapes into
+      worker threads participate.
+
+Four rules consume the analysis (catalog + worked examples:
+docs/static_analysis.md):
+
+  unguarded-shared-write   attr guarded in one method, mutated lockless
+                           in another (subsumes the r10
+                           serve-lock-discipline rule, now repo-wide,
+                           plus the Thread(target=) mutate-vs-iterate
+                           hazard)
+  lock-order-inversion     a cycle in the static lock-order graph
+  blocking-call-under-lock join/wait/sleep/subprocess/HTTP/chaos-seamed
+                           IO while holding a lock
+  thread-lifecycle         non-daemon thread with no join on any
+                           stop/drain path; Event.wait() without timeout
+                           inside a loop a drain cannot wake
+
+Scope: lock identity is resolved **within a module** (the repo's lock
+objects are all per-class or per-module singletons); a cross-module
+inversion is the runtime lockwatch twin's job (``pytest
+--ytk-lockwatch``, tools/ytklint/lockwatch.py).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .core import rule
+from .rules import _dotted, _tail_name
+
+_LOCK_CTORS = {"Lock", "RLock"}
+_OPAQUE_LOCK_RE = re.compile(r"(^|_)(lock|mutex)$", re.IGNORECASE)
+
+#: callables that block the calling thread (directly, or behind a chaos/
+#: retry seam that may sleep, raise, or kill) — holding a lock across one
+#: of these starves every sibling thread that needs the lock
+_BLOCKING_NAMES = {
+    "urlopen", "http_json", "spawn_replica", "stop_replica", "wait_ready",
+    "chaos_point", "retry_call", "retry_lines", "Popen", "check_call",
+    "check_output", "getresponse",
+}
+_BLOCKING_DOTTED_PREFIXES = ("subprocess.",)
+_BLOCKING_ATTR_TAILS = {"wait", "join", "getresponse", "recv", "accept",
+                        "connect", "communicate"}
+
+
+# ---------------------------------------------------------------------------
+# Per-function facts
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class _Write:
+    key: Tuple[Optional[str], str]  # (class name | None, attr path)
+    line: int
+    func: "_Func"
+    held: frozenset
+    is_init: bool
+    is_mutation: bool  # subscript / augmented (RMW) rather than a rebind
+
+
+@dataclass
+class _Iter:
+    key: Tuple[Optional[str], str]
+    line: int
+    func: "_Func"
+    held: frozenset
+    is_init: bool
+
+
+@dataclass
+class _Region:
+    lock: str
+    node: ast.With
+    start: int
+    end: int
+
+
+@dataclass
+class _ThreadCtor:
+    line: int
+    daemon: bool
+    target: Optional[str]
+    bound_to: Optional[str]  # "name", "self.attr", or list var it lands in
+    bound_kind: str  # "name" | "attr" | "list" | "unbound"
+    func: "_Func"
+
+
+class _Func:
+    """One FunctionDef with its concurrency-relevant facts."""
+
+    def __init__(self, node, cls: Optional[ast.ClassDef], qual: str):
+        self.node = node
+        self.cls = cls
+        self.name = node.name
+        self.qual = qual
+        self.regions: List[_Region] = []
+        self.writes: List[_Write] = []
+        self.iters: List[_Iter] = []
+        # (callee simple name, line, held locks)
+        self.calls: List[Tuple[str, int, frozenset]] = []
+        # (line, description, held locks) for directly blocking calls
+        self.blocking: List[Tuple[int, str, frozenset]] = []
+        # .join(<one variable arg>) under a lock: str.join unless the
+        # receiver turns out to be a thread binding (resolved module-wide
+        # in blocking_findings, after every _ThreadCtor is collected)
+        self.maybe_joins: List[Tuple[int, str, frozenset]] = []
+        # Event.wait() without timeout inside a loop: (line, event label)
+        self.untimed_waits: List[Tuple[int, str]] = []
+        self.threads: List[_ThreadCtor] = []
+        self.globals: Set[str] = set()
+        self.is_entry = False
+
+    def held_at(self, line: int, exclude: Optional[ast.With] = None) -> frozenset:
+        return frozenset(
+            r.lock for r in self.regions
+            if r.node is not exclude and r.start <= line <= r.end
+        )
+
+
+def _child_statements(node: ast.AST) -> Iterable[ast.AST]:
+    """Walk a function body without descending into nested function /
+    class scopes (those are analyzed as their own functions)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                          ast.ClassDef)):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+# ---------------------------------------------------------------------------
+# Module analysis
+# ---------------------------------------------------------------------------
+
+
+class ModuleConcurrency:
+    def __init__(self, tree: ast.Module):
+        self.tree = tree
+        self.module_names: Set[str] = set()
+        self.module_locks: Dict[str, str] = {}
+        self.module_events: Set[str] = set()
+        # per class-name: attr -> canonical lock id / event attrs
+        self.class_locks: Dict[str, Dict[str, str]] = {}
+        self.class_events: Dict[str, Set[str]] = {}
+        self.funcs: List[_Func] = []
+        self._entry_names: Set[str] = set()
+        self._parent: Dict[int, ast.AST] = {}
+        self._collect_module_level()
+        self._collect_class_locks()
+        self._collect_functions()
+        self._resolve_entries()
+        self.edges = self._build_order_graph()
+
+    # -- discovery --------------------------------------------------------
+
+    def _collect_module_level(self) -> None:
+        for stmt in self.tree.body:
+            if isinstance(stmt, (ast.Import, ast.ImportFrom)):
+                for alias in stmt.names:
+                    self.module_names.add((alias.asname or alias.name).split(".")[0])
+            elif isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                targets = stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+                for tgt in targets:
+                    if isinstance(tgt, ast.Name):
+                        self.module_names.add(tgt.id)
+                        val = stmt.value
+                        if isinstance(val, ast.Call):
+                            ctor = _tail_name(val.func)
+                            if ctor in _LOCK_CTORS or ctor == "Condition":
+                                self.module_locks[tgt.id] = tgt.id
+                            elif ctor == "Event":
+                                self.module_events.add(tgt.id)
+
+    def _collect_class_locks(self) -> None:
+        for cls in ast.walk(self.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            locks: Dict[str, str] = {}
+            conds: List[Tuple[str, ast.Call]] = []
+            events: Set[str] = set()
+            for node in ast.walk(cls):
+                if not (isinstance(node, ast.Assign)
+                        and isinstance(node.value, ast.Call)):
+                    continue
+                ctor = _tail_name(node.value.func)
+                for tgt in node.targets:
+                    if not (isinstance(tgt, ast.Attribute)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id == "self"):
+                        continue
+                    if ctor in _LOCK_CTORS:
+                        locks[tgt.attr] = f"{cls.name}.{tgt.attr}"
+                    elif ctor == "Condition":
+                        conds.append((tgt.attr, node.value))
+                    elif ctor == "Event":
+                        events.add(tgt.attr)
+            # a Condition wrapping a known lock guards the same state
+            for attr, call in conds:
+                wrapped = None
+                if call.args:
+                    a0 = call.args[0]
+                    if (isinstance(a0, ast.Attribute)
+                            and isinstance(a0.value, ast.Name)
+                            and a0.value.id == "self"):
+                        wrapped = locks.get(a0.attr)
+                locks[attr] = wrapped or f"{cls.name}.{attr}"
+            if locks:
+                self.class_locks[cls.name] = locks
+            if events:
+                self.class_events[cls.name] = events
+
+    def _collect_functions(self) -> None:
+        def visit(node, cls, prefix):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    visit(child, child, f"{prefix}{child.name}.")
+                elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    fn = _Func(child, cls, f"{prefix}{child.name}")
+                    self.funcs.append(fn)
+                    self._analyze_function(fn)
+                    # nested defs keep the enclosing class (closures over
+                    # self — e.g. a Thread(target=) escapee in a method)
+                    visit(child, cls, f"{prefix}{child.name}.")
+                else:
+                    visit(child, cls, prefix)
+
+        visit(self.tree, None, "")
+
+    # -- per-function extraction -----------------------------------------
+
+    def _resolve_lock(self, expr: ast.expr, fn: _Func) -> Optional[str]:
+        """A lock id for an expression naming a lock, else None."""
+        if isinstance(expr, ast.Call):
+            return None
+        if (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self" and fn.cls is not None):
+            locks = self.class_locks.get(fn.cls.name, {})
+            if expr.attr in locks:
+                return locks[expr.attr]
+        if isinstance(expr, ast.Name) and expr.id in self.module_locks:
+            return expr.id
+        tail = _tail_name(expr)
+        if tail and _OPAQUE_LOCK_RE.search(tail):
+            # e.g. `core.REGISTRY._lock`, `_state.lock`: an attribute of an
+            # imported/module object — opaque but still a lock for the
+            # guarded-state map and the order graph
+            return _dotted(expr) or tail
+        return None
+
+    def _is_event(self, expr: ast.expr, fn: _Func, local_events: Set[str]) -> Optional[str]:
+        if (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self" and fn.cls is not None):
+            if expr.attr in self.class_events.get(fn.cls.name, set()):
+                return f"self.{expr.attr}"
+        if isinstance(expr, ast.Name):
+            if expr.id in self.module_events or expr.id in local_events:
+                return expr.id
+        return None
+
+    def _write_key(self, target: ast.expr, fn: _Func):
+        """-> (key, is_mutation) for a self-attr / module-object write."""
+        mutation = False
+        t = target
+        while isinstance(t, ast.Subscript):
+            mutation = True
+            t = t.value
+        parts: List[str] = []
+        node = t
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        parts.reverse()
+        if isinstance(node, ast.Name):
+            root = node.id
+            if root == "self" and parts and fn.cls is not None:
+                return (fn.cls.name, ".".join(parts)), mutation
+            if root in self.module_names and parts:
+                return (None, f"{root}." + ".".join(parts)), mutation
+            if not parts and root in fn.globals:
+                return (None, root), mutation
+        return None, mutation
+
+    def _iter_key(self, expr: ast.expr, fn: _Func):
+        """Resolve `for x in <expr>` to a shared-state key when the
+        iterated container is a self attr / module object (optionally via
+        .items()/.values()/.keys(), list()/sorted()/tuple()/set())."""
+        e = expr
+        if (isinstance(e, ast.Call) and isinstance(e.func, ast.Name)
+                and e.func.id in ("list", "sorted", "tuple", "set")
+                and e.args):
+            e = e.args[0]
+        if (isinstance(e, ast.Call) and isinstance(e.func, ast.Attribute)
+                and e.func.attr in ("items", "values", "keys")
+                and not e.args):
+            e = e.func.value
+        key, _ = self._write_key(e, fn)
+        return key
+
+    def _analyze_function(self, fn: _Func) -> None:
+        node = fn.node
+        parent: Dict[int, ast.AST] = {}
+        for n in _child_statements(node):
+            for c in ast.iter_child_nodes(n):
+                parent[id(c)] = n
+        for c in ast.iter_child_nodes(node):
+            parent[id(c)] = node
+        is_init = fn.name in ("__init__", "__new__")
+        local_events: Set[str] = set()
+
+        for n in _child_statements(node):
+            if isinstance(n, ast.Global):
+                fn.globals.update(n.names)
+            elif (isinstance(n, ast.Assign) and isinstance(n.value, ast.Call)
+                  and _tail_name(n.value.func) == "Event"):
+                for tgt in n.targets:
+                    if isinstance(tgt, ast.Name):
+                        local_events.add(tgt.id)
+
+        # lock regions
+        for n in _child_statements(node):
+            if isinstance(n, (ast.With, ast.AsyncWith)):
+                for item in n.items:
+                    lock = self._resolve_lock(item.context_expr, fn)
+                    if lock is not None:
+                        fn.regions.append(_Region(
+                            lock, n, n.lineno, n.end_lineno or n.lineno
+                        ))
+
+        for n in _child_statements(node):
+            # shared-state writes
+            if isinstance(n, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = n.targets if isinstance(n, ast.Assign) else [n.target]
+                for tgt in targets:
+                    key, mut = self._write_key(tgt, fn)
+                    if key is None:
+                        continue
+                    if fn.cls is not None and key[1] in self.class_locks.get(
+                        fn.cls.name, {}
+                    ):
+                        continue  # binding the lock itself
+                    fn.writes.append(_Write(
+                        key, n.lineno, fn, fn.held_at(n.lineno),
+                        is_init, mut or isinstance(n, ast.AugAssign),
+                    ))
+            # shared-state iteration
+            elif isinstance(n, (ast.For, ast.AsyncFor)):
+                key = self._iter_key(n.iter, fn)
+                if key is not None:
+                    fn.iters.append(_Iter(
+                        key, n.lineno, fn, fn.held_at(n.lineno), is_init
+                    ))
+            elif isinstance(n, (ast.ListComp, ast.SetComp, ast.DictComp,
+                                ast.GeneratorExp)):
+                for gen in n.generators:
+                    key = self._iter_key(gen.iter, fn)
+                    if key is not None:
+                        fn.iters.append(_Iter(
+                            key, n.lineno, fn, fn.held_at(n.lineno), is_init
+                        ))
+            elif isinstance(n, ast.Call):
+                self._analyze_call(n, fn, parent, local_events)
+
+    def _analyze_call(self, n: ast.Call, fn: _Func, parent, local_events) -> None:
+        held = fn.held_at(n.lineno)
+        f = n.func
+        tail = _tail_name(f)
+        dotted = _dotted(f)
+
+        # call graph (same-module resolution by simple name)
+        if isinstance(f, ast.Name):
+            fn.calls.append((f.id, n.lineno, held))
+        elif (isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name)
+              and f.value.id == "self"):
+            fn.calls.append((f.attr, n.lineno, held))
+
+        # Thread(...) constructions
+        if tail == "Thread" and dotted in ("Thread", "threading.Thread"):
+            daemon = False
+            target = None
+            for kw in n.keywords:
+                if kw.arg == "daemon":
+                    daemon = bool(isinstance(kw.value, ast.Constant)
+                                  and kw.value.value)
+                elif kw.arg == "target":
+                    target = _tail_name(kw.value)
+            if target:
+                self._entry_names.add(target)
+            bound_to, bound_kind = self._thread_binding(n, parent)
+            fn.threads.append(_ThreadCtor(
+                n.lineno, daemon, target, bound_to, bound_kind, fn
+            ))
+            return
+
+        # signal handlers are thread-entry-like (async preemption)
+        if dotted == "signal.signal" and len(n.args) == 2:
+            name = _tail_name(n.args[1])
+            if name:
+                self._entry_names.add(name)
+
+        # Event.wait() without a timeout inside a loop
+        if (isinstance(f, ast.Attribute) and f.attr == "wait"
+                and not n.args
+                and not any(kw.arg == "timeout" for kw in n.keywords)):
+            ev = self._is_event(f.value, fn, local_events)
+            if ev is not None and self._in_loop(n, parent):
+                fn.untimed_waits.append((n.lineno, ev))
+
+        # blocking calls under a held lock
+        if not held:
+            return
+        desc = self._blocking_desc(n, fn, tail, dotted, held)
+        if desc is not None:
+            fn.blocking.append((n.lineno, desc, held))
+
+    def _blocking_desc(self, n: ast.Call, fn: _Func, tail, dotted, held):
+        f = n.func
+        if dotted in ("time.sleep", "sleep"):
+            return "time.sleep()"
+        if isinstance(f, ast.Attribute):
+            if tail == "wait":
+                # Condition.wait on the HELD lock releases it — that is
+                # the condition-variable protocol, not a hold
+                if self._resolve_lock(f.value, fn) in held:
+                    return None
+                return f"{_dotted(f)}() (wait)"
+            if tail == "join":
+                if isinstance(f.value, ast.Constant):
+                    return None  # str.join
+                if (len(n.args) == 1 and not n.keywords
+                        and not (isinstance(n.args[0], ast.Constant)
+                                 and isinstance(n.args[0].value, (int, float)))):
+                    # single variable arg: str.join(iterable) — UNLESS the
+                    # receiver is a thread binding (t.join(self.timeout)),
+                    # which only the module-wide _ThreadCtor set can tell;
+                    # defer to blocking_findings()
+                    recv = _dotted(f.value)
+                    if recv:
+                        fn.maybe_joins.append((n.lineno, recv, held))
+                    return None
+                return f"{_dotted(f)}() (thread/process join)"
+            if tail in _BLOCKING_ATTR_TAILS:
+                return f"{_dotted(f)}()"
+        if tail in _BLOCKING_NAMES:
+            return f"{dotted or tail}()"
+        if any(dotted.startswith(p) for p in _BLOCKING_DOTTED_PREFIXES):
+            return f"{dotted}()"
+        return None
+
+    @staticmethod
+    def _in_loop(n: ast.AST, parent: Dict[int, ast.AST]) -> bool:
+        cur = parent.get(id(n))
+        while cur is not None:
+            if isinstance(cur, (ast.While, ast.For, ast.AsyncFor)):
+                return True
+            cur = parent.get(id(cur))
+        return False
+
+    @staticmethod
+    def _thread_binding(n: ast.Call, parent) -> Tuple[Optional[str], str]:
+        """Where does this Thread object land? -> (name, kind)."""
+        cur, prev = parent.get(id(n)), n
+        while cur is not None:
+            if isinstance(cur, ast.Assign):
+                tgt = cur.targets[0]
+                if isinstance(tgt, ast.Name):
+                    kind = "list" if isinstance(
+                        prev, (ast.List, ast.ListComp, ast.Tuple)
+                    ) else "name"
+                    return tgt.id, kind
+                if (isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"):
+                    return f"self.{tgt.attr}", "attr"
+                return None, "unbound"
+            if (isinstance(cur, ast.Call)
+                    and isinstance(cur.func, ast.Attribute)
+                    and cur.func.attr == "append"
+                    and isinstance(cur.func.value, ast.Name)):
+                return cur.func.value.id, "list"
+            if (isinstance(cur, ast.Attribute) and cur.attr == "start"):
+                return None, "unbound"  # Thread(...).start() inline
+            prev, cur = cur, parent.get(id(cur))
+        return None, "unbound"
+
+    # -- whole-module resolution -----------------------------------------
+
+    def _resolve_entries(self) -> None:
+        """Mark thread/signal/HTTP-handler entry functions, then close
+        over the same-module call graph (an inversion or a shared-state
+        mutation two calls below a Thread target is still on that
+        thread)."""
+        by_name: Dict[str, List[_Func]] = {}
+        for fn in self.funcs:
+            by_name.setdefault(fn.name, []).append(fn)
+        roots: List[_Func] = []
+        for fn in self.funcs:
+            if fn.name in self._entry_names:
+                fn.is_entry = True
+                roots.append(fn)
+            elif fn.cls is not None and any(
+                _tail_name(b) == "BaseHTTPRequestHandler"
+                for b in fn.cls.bases
+            ):
+                fn.is_entry = True
+                roots.append(fn)
+        seen = set(id(f) for f in roots)
+        stack = list(roots)
+        while stack:
+            fn = stack.pop()
+            for callee, _line, _held in fn.calls:
+                for g in by_name.get(callee, []):
+                    if id(g) not in seen:
+                        seen.add(id(g))
+                        g.is_entry = True
+                        stack.append(g)
+        self._by_name = by_name
+
+    def _build_order_graph(self):
+        """(a, b) -> (line, context) edges: `with b` entered while a held."""
+        edges: Dict[Tuple[str, str], Tuple[int, str]] = {}
+
+        def add(a: str, b: str, line: int, ctx: str) -> None:
+            if a != b and (a, b) not in edges:
+                edges[(a, b)] = (line, ctx)
+
+        for fn in self.funcs:
+            for r in fn.regions:
+                for a in fn.held_at(r.start, exclude=r.node):
+                    add(a, r.lock, r.start, f"in `{fn.qual}`")
+            # `with a, b:` — one statement, ordered acquisition
+            for n in _child_statements(fn.node):
+                if isinstance(n, (ast.With, ast.AsyncWith)) and len(n.items) > 1:
+                    ids = [self._resolve_lock(i.context_expr, fn)
+                           for i in n.items]
+                    for i, a in enumerate(ids):
+                        for b in ids[i + 1:]:
+                            if a and b:
+                                add(a, b, n.lineno, f"in `{fn.qual}`")
+            # one-level call propagation: calling f() while holding A
+            # acquires whatever f acquires
+            for callee, line, held in fn.calls:
+                if not held:
+                    continue
+                for g in self._by_name.get(callee, []):
+                    for r in g.regions:
+                        for a in held:
+                            add(a, r.lock, line,
+                                f"in `{fn.qual}` via `{callee}()`")
+        return edges
+
+    def order_cycles(self):
+        """Edges that participate in a cycle: [(a, b, line, ctx, path)]."""
+        graph: Dict[str, Set[str]] = {}
+        for (a, b) in self.edges:
+            graph.setdefault(a, set()).add(b)
+
+        def reaches(src: str, dst: str) -> Optional[List[str]]:
+            stack = [(src, [src])]
+            seen = set()
+            while stack:
+                cur, path = stack.pop()
+                if cur == dst:
+                    return path
+                if cur in seen:
+                    continue
+                seen.add(cur)
+                for nxt in sorted(graph.get(cur, ())):
+                    stack.append((nxt, path + [nxt]))
+            return None
+
+        out = []
+        for (a, b), (line, ctx) in sorted(
+            self.edges.items(), key=lambda kv: kv[1][0]
+        ):
+            path = reaches(b, a)
+            if path is not None:
+                out.append((a, b, line, ctx, path))
+        return out
+
+    # -- blocking with one-level propagation ------------------------------
+
+    def blocking_findings(self):
+        # every name/self-attr a Thread object was ever bound to: the
+        # disambiguator for `x.join(<one variable arg>)` (thread join with
+        # a variable timeout vs str.join(iterable))
+        thread_bindings: Set[str] = set()
+        for fn in self.funcs:
+            for t in fn.threads:
+                if t.bound_to:
+                    thread_bindings.add(t.bound_to)
+        out = []
+        for fn in self.funcs:
+            for line, desc, held in fn.blocking:
+                out.append((line, desc, held, fn, None))
+            for line, recv, held in fn.maybe_joins:
+                if recv in thread_bindings:
+                    out.append((
+                        line, f"{recv}.join() (thread join)", held, fn, None
+                    ))
+            for callee, line, held in fn.calls:
+                if not held:
+                    continue
+                for g in self._by_name.get(callee, []):
+                    if g is fn:
+                        continue
+                    direct = [(ln, d) for ln, d, _h in g.blocking] + [
+                        (ln, d) for ln, d in _direct_blocking_anywhere(g)
+                    ]
+                    if direct:
+                        out.append((line, direct[0][1], held, fn, callee))
+                        break
+        return out
+
+
+def _direct_blocking_anywhere(fn: _Func):
+    """Blocking calls in `fn` regardless of lock state (for one-level
+    propagation: the CALLER holds the lock, the callee blocks)."""
+    out = []
+    for n in _child_statements(fn.node):
+        if not isinstance(n, ast.Call):
+            continue
+        tail = _tail_name(n.func)
+        dotted = _dotted(n.func)
+        if dotted in ("time.sleep", "sleep"):
+            out.append((n.lineno, "time.sleep()"))
+        elif tail in _BLOCKING_NAMES:
+            out.append((n.lineno, f"{dotted or tail}()"))
+        elif any(dotted.startswith(p) for p in _BLOCKING_DOTTED_PREFIXES):
+            out.append((n.lineno, f"{dotted}()"))
+    return out
+
+
+def _analysis(ctx) -> ModuleConcurrency:
+    cached = getattr(ctx, "_concurrency", None)
+    if cached is None:
+        cached = ctx._concurrency = ModuleConcurrency(ctx.tree)
+    return cached
+
+
+def _key_str(key: Tuple[Optional[str], str]) -> str:
+    cls, path = key
+    return f"self.{path}" if cls else path
+
+
+# ---------------------------------------------------------------------------
+# Rule 8: unguarded-shared-write
+# ---------------------------------------------------------------------------
+
+
+@rule(
+    "unguarded-shared-write",
+    "shared attribute/global written under a lock in one method but "
+    "mutated lockless in another, or mutated on a Thread(target=) path "
+    "while iterated lockless elsewhere (subsumes serve-lock-discipline)",
+)
+def unguarded_shared_write(ctx) -> Iterable[Tuple[int, str]]:
+    mod = _analysis(ctx)
+    writes_by_key: Dict[Tuple, List[_Write]] = {}
+    iters_by_key: Dict[Tuple, List[_Iter]] = {}
+    for fn in mod.funcs:
+        for w in fn.writes:
+            writes_by_key.setdefault(w.key, []).append(w)
+        for it in fn.iters:
+            iters_by_key.setdefault(it.key, []).append(it)
+
+    reported: Set[Tuple] = set()
+    # (A) the guarded-state map: a key ever written under a lock must
+    # never be written lockless outside __init__/module init
+    for key, writes in sorted(writes_by_key.items(), key=lambda kv: kv[0][1]):
+        guards = sorted(set().union(*[w.held for w in writes]))
+        if not guards:
+            continue
+        for w in writes:
+            if w.is_init or w.held:
+                continue
+            reported.add(key)
+            owner = f"`{key[0]}`" if key[0] else "this module"
+            yield (w.line,
+                   f"{_key_str(key)} is written under "
+                   f"{'/'.join(guards)} elsewhere in {owner} but mutated "
+                   f"without it in `{w.func.name}` — take the lock or "
+                   "document why this write cannot race")
+
+    # (B) Thread(target=) escapes: mutated on a thread path, iterated
+    # lockless in another method with no common lock — the dict/list can
+    # change shape mid-iteration
+    for key, writes in sorted(writes_by_key.items(), key=lambda kv: kv[0][1]):
+        if key in reported:
+            continue
+        for w in writes:
+            if w.is_init or not w.is_mutation or not w.func.is_entry:
+                continue
+            racing = [
+                it for it in iters_by_key.get(key, [])
+                if it.func is not w.func and not it.is_init
+                and not (w.held & it.held)
+            ]
+            if racing:
+                others = sorted({it.func.name for it in racing})
+                reported.add(key)
+                yield (w.line,
+                       f"{_key_str(key)} is mutated on a thread path in "
+                       f"`{w.func.name}` but iterated without a common "
+                       f"lock in `{'`/`'.join(others)}` — guard both "
+                       "sides with one lock or document why the phases "
+                       "cannot overlap")
+                break
+
+
+# ---------------------------------------------------------------------------
+# Rule 9: lock-order-inversion
+# ---------------------------------------------------------------------------
+
+
+@rule(
+    "lock-order-inversion",
+    "cycle in the static lock-acquisition-order graph (two code paths "
+    "taking the same locks in opposite orders can deadlock)",
+)
+def lock_order_inversion(ctx) -> Iterable[Tuple[int, str]]:
+    mod = _analysis(ctx)
+    for a, b, line, where, path in mod.order_cycles():
+        back = " -> ".join(path)
+        yield (line,
+               f"lock order inversion: {a} -> {b} {where}, but the "
+               f"graph also orders {back} — two threads taking these "
+               "locks in opposite orders deadlock; pick one global "
+               "order")
+
+
+# ---------------------------------------------------------------------------
+# Rule 10: blocking-call-under-lock
+# ---------------------------------------------------------------------------
+
+
+@rule(
+    "blocking-call-under-lock",
+    "join/wait/sleep/subprocess/HTTP/chaos-seamed IO while holding a "
+    "lock — every sibling thread needing the lock stalls for the whole "
+    "call (the r14 synchronous-respawn bug class)",
+)
+def blocking_call_under_lock(ctx) -> Iterable[Tuple[int, str]]:
+    mod = _analysis(ctx)
+    seen: Set[Tuple[int, str]] = set()
+    for line, desc, held, fn, via in sorted(
+        mod.blocking_findings(), key=lambda t: t[0]
+    ):
+        if (line, desc) in seen:
+            continue
+        seen.add((line, desc))
+        locks = "/".join(sorted(held))
+        via_s = f" (via `{via}()`)" if via else ""
+        yield (line,
+               f"{desc}{via_s} while holding {locks} in `{fn.qual}` — "
+               "the lock is held for the whole blocking call; move the "
+               "call outside the lock or document why every waiter "
+               "must stall")
+
+
+# ---------------------------------------------------------------------------
+# Rule 11: thread-lifecycle
+# ---------------------------------------------------------------------------
+
+
+def _has_join_for(mod: ModuleConcurrency, t: _ThreadCtor) -> bool:
+    """Is there a plausible join for this thread binding anywhere in the
+    module? `self.attr.join(...)` / `name.join(...)` directly, or a
+    `for v in <list>: v.join(...)` sweep over the list it landed in."""
+    want_attr = t.bound_to[5:] if (t.bound_kind == "attr" and t.bound_to) else None
+    want_name = t.bound_to if t.bound_kind in ("name", "list") else None
+    for fn in mod.funcs:
+        loop_vars: Dict[str, Set[str]] = {}
+        for n in _child_statements(fn.node):
+            if isinstance(n, (ast.For, ast.AsyncFor)):
+                names = {
+                    x.id for x in ast.walk(n.iter) if isinstance(x, ast.Name)
+                }
+                if isinstance(n.target, ast.Name):
+                    loop_vars.setdefault(n.target.id, set()).update(names)
+        for n in _child_statements(fn.node):
+            if not (isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and n.func.attr == "join"):
+                continue
+            recv = n.func.value
+            if (want_attr and isinstance(recv, ast.Attribute)
+                    and recv.attr == want_attr
+                    and isinstance(recv.value, ast.Name)
+                    and recv.value.id == "self"):
+                return True
+            if want_name and isinstance(recv, ast.Name):
+                if recv.id == want_name:
+                    return True
+                if want_name in loop_vars.get(recv.id, set()):
+                    return True
+    return False
+
+
+@rule(
+    "thread-lifecycle",
+    "non-daemon thread with no join on any stop/drain path (shutdown "
+    "hangs on it), or Event.wait() without timeout inside a loop a "
+    "drain cannot wake",
+)
+def thread_lifecycle(ctx) -> Iterable[Tuple[int, str]]:
+    mod = _analysis(ctx)
+    for fn in mod.funcs:
+        for t in fn.threads:
+            if t.daemon:
+                continue
+            if t.bound_kind == "unbound" or not _has_join_for(mod, t):
+                yield (t.line,
+                       "non-daemon thread is never joined — interpreter "
+                       "shutdown blocks on it forever; join it on the "
+                       "stop/drain path or mark it daemon=True")
+        for line, ev in fn.untimed_waits:
+            yield (line,
+                   f"{ev}.wait() without a timeout inside a loop in "
+                   f"`{fn.qual}` — a drain that races the wait can "
+                   "never wake it; wait(timeout=...) and re-check the "
+                   "loop condition")

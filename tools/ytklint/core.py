@@ -8,10 +8,22 @@ through the suppression map, and reports malformed suppressions
 (missing/empty ``reason=``, unknown rule names) as findings themselves so
 a typo can never silently disable a check.
 
+Suppression hygiene is two-sided: a suppression whose rule *ran* on the
+file but produced nothing on the covered line is itself an
+``unused-suppression`` finding — as code moves, the suppression
+inventory cannot silently drift into a pile of dead annotations (each of
+which would hide a FUTURE finding on whatever lands on that line).
+
 Suppression grammar (same line as the finding, or a comment line
 immediately above it):
 
     # ytklint: allow(rule-a, rule-b) reason=why this is safe here
+
+Machine-readable output: ``python -m tools.ytklint --format json`` emits
+one JSON document (schema "ytklint") carrying the findings AND the live
+suppression inventory (rule, path, line, message, reason) —
+``scripts/obs_report.py`` renders it, so CI annotations and postmortems
+share one artifact.
 """
 
 from __future__ import annotations
@@ -21,7 +33,7 @@ import pathlib
 import re
 import sys
 from dataclasses import dataclass
-from typing import Callable, Dict, Iterable, List, Optional, Sequence, Set
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 SUPPRESS_RE = re.compile(
     r"#\s*ytklint:\s*allow\(\s*([a-z0-9_, -]*?)\s*\)\s*(?:reason=(.*))?$"
@@ -49,8 +61,19 @@ class Rule:
 
 RULES: Dict[str, Rule] = {}
 
-# short spellings accepted in allow() comments
-RULE_ALIASES = {"broad-except": "broad-except-swallow"}
+# short / legacy spellings accepted in allow() comments and --select.
+# serve-lock-discipline graduated into the repo-wide unguarded-shared-write
+# (tools/ytklint/concurrency.py) — the alias keeps every existing
+# suppression, docs reference, and --select invocation valid (the
+# check_no_print.sh delegating-wrapper precedent).
+RULE_ALIASES = {
+    "broad-except": "broad-except-swallow",
+    "serve-lock-discipline": "unguarded-shared-write",
+}
+
+
+def resolve_rule_name(name: str) -> str:
+    return RULE_ALIASES.get(name, name)
 
 
 def _applies_everywhere(path: str) -> bool:
@@ -77,8 +100,10 @@ class FileContext:
         self.source = source
         self.lines = source.splitlines()
         self.tree = ast.parse(source, path)
-        # line -> set of rule names allowed there
-        self.allows: Dict[int, Set[str]] = {}
+        # line -> {rule name -> (comment line, reason)}
+        self.allows: Dict[int, Dict[str, Tuple[int, str]]] = {}
+        # every well-formed suppression: (comment line, rule, reason)
+        self.suppressions: List[Tuple[int, str, str]] = []
         self.bad_suppressions: List[Finding] = []
         self._parse_suppressions()
 
@@ -96,7 +121,7 @@ class FileContext:
                     ))
                 continue
             names = {
-                RULE_ALIASES.get(n.strip(), n.strip())
+                resolve_rule_name(n.strip())
                 for n in m.group(1).split(",")
                 if n.strip()
             }
@@ -119,37 +144,93 @@ class FileContext:
             # a comment-only line suppresses the statement below it
             if raw.strip().startswith("#"):
                 targets.append(i + 1)
-            for t in targets:
-                self.allows.setdefault(t, set()).update(names)
+            for name in sorted(names):
+                self.suppressions.append((i, name, reason))
+                for t in targets:
+                    self.allows.setdefault(t, {})[name] = (i, reason)
 
-    def allowed(self, rule_name: str, line: int) -> bool:
-        return rule_name in self.allows.get(line, ())
+    def allowed(self, rule_name: str, line: int) -> Optional[Tuple[int, str]]:
+        """(comment line, reason) when suppressed at `line`, else None."""
+        return self.allows.get(line, {}).get(rule_name)
+
+
+@dataclass
+class FileReport:
+    """Everything one file produced: live findings, the suppressed ones
+    (with their reasons — the machine-readable inventory), and which
+    suppression comments actually fired."""
+
+    findings: List[Finding]
+    suppressed: List[dict]
+
+
+def _run_rules(
+    ctx: FileContext, select: Optional[Sequence[str]]
+) -> FileReport:
+    findings: List[Finding] = list(ctx.bad_suppressions)
+    suppressed: List[dict] = []
+    used: Set[Tuple[int, str]] = set()
+    selected = (
+        None if select is None
+        else {resolve_rule_name(s) for s in select}
+    )
+    ran: Set[str] = set()
+    for r in RULES.values():
+        if selected is not None and r.name not in selected:
+            continue
+        ran.add(r.name)
+        if not r.applies(ctx.path):
+            continue
+        for line, msg in r.check(ctx):
+            hit = ctx.allowed(r.name, line)
+            if hit is None:
+                findings.append(Finding(ctx.path, line, r.name, msg))
+            else:
+                comment_line, reason = hit
+                used.add((comment_line, r.name))
+                suppressed.append({
+                    "rule": r.name, "path": ctx.path, "line": line,
+                    "message": msg, "reason": reason,
+                    "comment_line": comment_line,
+                })
+    # the stale-suppression audit: every well-formed suppression whose
+    # rule RAN here must have filtered at least one finding — anything
+    # else is inventory drift (and a hiding place for a future finding)
+    for comment_line, name, _reason in ctx.suppressions:
+        if name in ran and (comment_line, name) not in used:
+            findings.append(Finding(
+                ctx.path, comment_line, "unused-suppression",
+                f"allow({name}) no longer matches a finding on the line "
+                "it covers — the code moved or the issue was fixed; "
+                "delete the suppression",
+            ))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return FileReport(findings, suppressed)
 
 
 def lint_source(
     source: str, path: str, select: Optional[Sequence[str]] = None
 ) -> List[Finding]:
     """Lint one source string under a (virtual) repo-relative path."""
+    return lint_source_report(source, path, select).findings
+
+
+def lint_source_report(
+    source: str, path: str, select: Optional[Sequence[str]] = None
+) -> FileReport:
     try:
         ctx = FileContext(path, source)
     except SyntaxError as e:
-        return [Finding(path, e.lineno or 1, "syntax-error", str(e.msg))]
-    findings: List[Finding] = list(ctx.bad_suppressions)
-    for r in RULES.values():
-        if select and r.name not in select:
-            continue
-        if not r.applies(ctx.path):
-            continue
-        for line, msg in r.check(ctx):
-            if not ctx.allowed(r.name, line):
-                findings.append(Finding(ctx.path, line, r.name, msg))
-    findings.sort(key=lambda f: (f.path, f.line, f.rule))
-    return findings
+        return FileReport(
+            [Finding(path, e.lineno or 1, "syntax-error", str(e.msg))], []
+        )
+    return _run_rules(ctx, select)
 
 
-# path-scoped rules (bare-print, serve-lock-discipline) match repo-relative
-# prefixes, so every linted file is relativized against this checkout —
-# absolute-path invocations must not silently skip scoped rules
+# path-scoped rules (bare-print, the concurrency set's serve heritage)
+# match repo-relative prefixes, so every linted file is relativized
+# against this checkout — absolute-path invocations must not silently
+# skip scoped rules
 _REPO_ROOT = pathlib.Path(__file__).resolve().parents[2]
 
 
@@ -179,25 +260,55 @@ def _iter_py_files(paths: Sequence[str]) -> Iterable[pathlib.Path]:
 def lint_paths(
     paths: Sequence[str], select: Optional[Sequence[str]] = None
 ) -> List[Finding]:
+    return lint_paths_report(paths, select)["findings"]
+
+
+def lint_paths_report(
+    paths: Sequence[str], select: Optional[Sequence[str]] = None
+) -> dict:
+    """-> {"findings": [Finding], "suppressed": [dict], "files": int}."""
     findings: List[Finding] = []
+    suppressed: List[dict] = []
     n_files = 0
     for f in _iter_py_files(paths):
         n_files += 1
-        findings.extend(
-            lint_source(f.read_text(encoding="utf-8"), _rel(f), select)
-        )
+        rep = lint_source_report(f.read_text(encoding="utf-8"), _rel(f), select)
+        findings.extend(rep.findings)
+        suppressed.extend(rep.suppressed)
     if n_files == 0:
         raise FileNotFoundError(
             f"ytklint: no .py files under {list(paths)!r}"
         )
-    return findings
+    return {"findings": findings, "suppressed": suppressed, "files": n_files}
 
 
 DEFAULT_PATHS = ("ytklearn_tpu", "scripts", "bench.py")
 
 
+def report_json(report: dict, select: Optional[Sequence[str]] = None) -> dict:
+    """The machine-readable artifact (schema "ytklint"): findings +
+    the live suppression inventory, one document for CI annotations and
+    obs_report postmortems alike."""
+    rules_run = sorted(
+        RULES if select is None else {resolve_rule_name(s) for s in select}
+    )
+    return {
+        "schema": "ytklint",
+        "schema_version": 1,
+        "rules": rules_run,
+        "files": report["files"],
+        "findings": [
+            {"rule": f.rule, "path": f.path, "line": f.line,
+             "message": f.message, "suppressed": False}
+            for f in report["findings"]
+        ],
+        "suppressed": report["suppressed"],
+    }
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     import argparse
+    import json
 
     ap = argparse.ArgumentParser(
         prog="ytklint",
@@ -206,7 +317,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ap.add_argument("paths", nargs="*", default=None,
                     help=f"files/dirs to lint (default: {' '.join(DEFAULT_PATHS)})")
     ap.add_argument("--select", action="append", default=None,
-                    metavar="RULE", help="run only these rules (repeatable)")
+                    metavar="RULE", help="run only these rules (repeatable; "
+                    "aliases like serve-lock-discipline accepted)")
+    ap.add_argument("--format", choices=("text", "json"), default="text",
+                    help="json: one machine-readable document on stdout "
+                    "(findings + live suppression inventory)")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule catalog and exit")
     args = ap.parse_args(argv)
@@ -214,19 +329,28 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if args.list_rules:
         for r in RULES.values():
             print(f"{r.name:24s} {r.doc}")
+        for alias, target in sorted(RULE_ALIASES.items()):
+            print(f"{alias:24s} (alias of {target})")
         return 0
     if args.select:
-        unknown = [s for s in args.select if s not in RULES]
+        unknown = [
+            s for s in args.select if resolve_rule_name(s) not in RULES
+        ]
         if unknown:
             print(f"ytklint: unknown rule(s): {', '.join(unknown)}",
                   file=sys.stderr)
             return 2
     paths = args.paths or list(DEFAULT_PATHS)
     try:
-        findings = lint_paths(paths, args.select)
+        report = lint_paths_report(paths, args.select)
     except FileNotFoundError as e:
         print(str(e), file=sys.stderr)
         return 2
+    findings = report["findings"]
+    if args.format == "json":
+        json.dump(report_json(report, args.select), sys.stdout, indent=1)
+        sys.stdout.write("\n")
+        return 1 if findings else 0
     for f in findings:
         print(str(f), file=sys.stderr)
     n_rules = len(args.select) if args.select else len(RULES)
@@ -237,5 +361,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             file=sys.stderr,
         )
         return 1
-    print(f"ytklint: OK ({n_rules} rules)", file=sys.stderr)
+    print(
+        f"ytklint: OK ({n_rules} rules, {report['files']} files, "
+        f"{len(report['suppressed'])} reasoned suppressions)",
+        file=sys.stderr,
+    )
     return 0

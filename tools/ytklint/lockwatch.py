@@ -1,0 +1,243 @@
+"""Runtime lock-watch: the dynamic twin of the static concurrency rules.
+
+The static pass (concurrency.py) reasons about lock *names* within a
+module; this watcher observes lock *instances* at runtime, across every
+module at once — exactly the split the sanitizer mode already uses for
+host-sync-in-jit (static heuristic, `--ytk-sanitize` ground truth).
+
+``pytest --ytk-lockwatch`` (tests/conftest.py) wraps each
+``@pytest.mark.threaded`` test: ``threading.Lock``/``threading.RLock``
+are monkey-patched so every lock **created during the test** is a
+watched proxy (``threading.Condition``/``Event``/app objects built in
+the test body inherit them transparently). The watcher keeps, per
+thread, the stack of held locks with their acquisition sites, and
+maintains one global acquisition-order graph:
+
+  * acquiring B while holding A records the edge A→B **before** the real
+    acquire (a would-be deadlock must be reported, not hung on); if B
+    already reaches A in the graph, that is an observed lock-order
+    inversion — the test fails loud, naming both acquisition sites.
+    Two threads need not actually interleave: the r14 bug class is
+    caught the first time both orders are *exercised*, even sequentially.
+  * releasing a lock held longer than ``YTK_LOCKWATCH_HOLD_MS`` fails
+    the test too — the runtime form of blocking-call-under-lock (the
+    monitor thread that once sat tens of seconds inside a synchronous
+    respawn would have tripped this instantly).
+  * ``Condition.wait`` is handled naturally: the condition releases the
+    underlying watched lock (hold ends) and re-acquires on wake (a new
+    hold begins) — the wait itself is never charged as a hold.
+
+Staging discipline mirrors ``--ytk-sanitize``: build module-scoped
+fixtures BEFORE the watch (their locks stay unwatched); everything the
+threaded test body constructs is watched.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import traceback
+from typing import Dict, List, Optional, Set, Tuple
+
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+
+
+def _default_hold_ms() -> float:
+    try:
+        from ytklearn_tpu.config import knobs
+
+        return float(knobs.get_float("YTK_LOCKWATCH_HOLD_MS"))
+    except Exception:  # pragma: no cover - knobs registry always importable in-repo
+        return 1000.0
+
+
+def _call_site(skip_internal: bool = True) -> str:
+    """file:line of the nearest frame outside lockwatch/threading."""
+    for frame in reversed(traceback.extract_stack()):
+        fn = frame.filename.replace("\\", "/")
+        if skip_internal and (
+            fn.endswith("tools/ytklint/lockwatch.py")
+            or fn.endswith("/threading.py")
+        ):
+            continue
+        return f"{'/'.join(fn.rsplit('/', 3)[-2:])}:{frame.lineno}"
+    return "<unknown>"
+
+
+class _Held:
+    __slots__ = ("lock", "t0", "site", "reentrant")
+
+    def __init__(self, lock, t0, site, reentrant):
+        self.lock = lock
+        self.t0 = t0
+        self.site = site
+        self.reentrant = reentrant
+
+
+class WatchedLock:
+    """Proxy over a real lock. Implements the subset threading.Condition
+    needs (acquire/release + AttributeError for _release_save & co., so
+    Condition falls back to its plain-lock protocol)."""
+
+    def __init__(self, watch: "LockWatch", real, kind: str):
+        self._watch = watch
+        self._real = real
+        self._kind = kind
+        self.label = f"{kind}@{_call_site()}"
+
+    def acquire(self, blocking=True, timeout=-1):
+        self._watch._before_acquire(self)
+        ok = self._real.acquire(blocking, timeout)
+        if ok:
+            self._watch._after_acquire(self)
+        return ok
+
+    def release(self):
+        self._watch._before_release(self)
+        self._real.release()
+
+    def locked(self):
+        return self._real.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return f"<WatchedLock {self.label}>"
+
+
+class LockWatch:
+    """One watch session: install(), run threaded code, uninstall(),
+    then read .violations (fail the test when non-empty)."""
+
+    def __init__(self, hold_ms: Optional[float] = None):
+        self.hold_ms = _default_hold_ms() if hold_ms is None else float(hold_ms)
+        self._meta = _REAL_LOCK()
+        self._tls = threading.local()
+        # order graph over lock instances: id -> set of successor ids
+        self._graph: Dict[int, Set[int]] = {}
+        # (a_id, b_id) -> "held <a> at <site>, acquired <b> at <site>"
+        self._edge_sites: Dict[Tuple[int, int], str] = {}
+        self._labels: Dict[int, str] = {}
+        self.violations: List[str] = []
+        self._installed = False
+
+    # -- factory patching -------------------------------------------------
+
+    def install(self) -> "LockWatch":
+        if self._installed:
+            return self
+        watch = self
+
+        def make_lock():
+            return WatchedLock(watch, _REAL_LOCK(), "Lock")
+
+        def make_rlock():
+            return WatchedLock(watch, _REAL_RLOCK(), "RLock")
+
+        threading.Lock = make_lock
+        threading.RLock = make_rlock
+        self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        if self._installed:
+            threading.Lock = _REAL_LOCK
+            threading.RLock = _REAL_RLOCK
+            self._installed = False
+
+    # -- per-thread stack --------------------------------------------------
+
+    def _stack(self) -> List[_Held]:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def held_now(self) -> List[str]:
+        return [h.lock.label for h in self._stack() if not h.reentrant]
+
+    # -- acquire/release hooks --------------------------------------------
+
+    def _before_acquire(self, lock: WatchedLock) -> None:
+        stack = self._stack()
+        held = [h.lock for h in stack if not h.reentrant]
+        if any(h is lock for h in held):
+            return  # RLock re-entry: no new edge, no new hold
+        b = id(lock)
+        site = _call_site()
+        with self._meta:
+            self._labels[b] = lock.label
+            for a_lock in held:
+                a = id(a_lock)
+                self._labels[a] = a_lock.label
+                if b in self._graph.setdefault(a, set()):
+                    continue  # known edge: cycle (if any) already reported
+                self._graph[a].add(b)
+                self._edge_sites[(a, b)] = (
+                    f"holding {a_lock.label}, acquired {lock.label} "
+                    f"at {site} in {threading.current_thread().name}"
+                )
+                # any NEW cycle must contain this new edge, so checking
+                # only on edge insertion is complete — and it dedups (a
+                # hammer re-exercising one inversion reports it once)
+                path = self._reaches(b, a)
+                if path is not None:
+                    back = " -> ".join(self._labels[n] for n in path)
+                    self.violations.append(
+                        "lock-order inversion: "
+                        f"{self._edge_sites[(a, b)]}, but the order graph "
+                        f"already holds {back} "
+                        f"({self._edge_sites.get((path[0], path[1]), '?')})"
+                    )
+
+    def _after_acquire(self, lock: WatchedLock) -> None:
+        stack = self._stack()
+        reentrant = any(h.lock is lock and not h.reentrant for h in stack)
+        stack.append(_Held(lock, time.perf_counter(), _call_site(), reentrant))
+
+    def _before_release(self, lock: WatchedLock) -> None:
+        stack = self._stack()
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i].lock is lock:
+                h = stack.pop(i)
+                if not h.reentrant:
+                    held_ms = (time.perf_counter() - h.t0) * 1e3
+                    if held_ms > self.hold_ms:
+                        with self._meta:
+                            self.violations.append(
+                                f"lock hold over budget: {lock.label} held "
+                                f"{held_ms:.1f} ms (> YTK_LOCKWATCH_HOLD_MS="
+                                f"{self.hold_ms:g}) — acquired at {h.site} "
+                                f"in {threading.current_thread().name}"
+                            )
+                return
+        # release of a lock this thread never acquired through the watch
+        # (e.g. Condition internals): ignore silently
+
+    def _reaches(self, src: int, dst: int) -> Optional[List[int]]:
+        """Path src -> ... -> dst in the order graph (caller holds _meta)."""
+        stack = [(src, [src])]
+        seen: Set[int] = set()
+        while stack:
+            cur, path = stack.pop()
+            if cur == dst:
+                return path
+            if cur in seen:
+                continue
+            seen.add(cur)
+            for nxt in self._graph.get(cur, ()):
+                stack.append((nxt, path + [nxt]))
+        return None
+
+    # -- reporting ---------------------------------------------------------
+
+    def report(self) -> List[str]:
+        with self._meta:
+            return list(self.violations)

@@ -451,111 +451,9 @@ def sleep_in_except(ctx) -> Iterable[Tuple[int, str]]:
 
 
 # ---------------------------------------------------------------------------
-# Rule 7: serve-lock-discipline
+# serve-lock-discipline (r10) graduated into the repo-wide concurrency
+# pass: tools/ytklint/concurrency.py's `unguarded-shared-write` subsumes
+# it (guarded-state map over every package, module globals, Thread
+# escapes). core.RULE_ALIASES keeps the old name valid in allow()
+# comments and --select — the check_no_print.sh delegating precedent.
 # ---------------------------------------------------------------------------
-
-
-def _lock_attrs(cls: ast.ClassDef) -> Set[str]:
-    """self attributes bound to threading.Lock/RLock/Condition in __init__
-    (a Condition wrapping a Lock guards the same state)."""
-    locks: Set[str] = set()
-    for item in cls.body:
-        if isinstance(item, ast.FunctionDef) and item.name == "__init__":
-            for node in ast.walk(item):
-                if not (isinstance(node, ast.Assign) and
-                        isinstance(node.value, ast.Call)):
-                    continue
-                ctor = _tail_name(node.value.func)
-                if ctor not in ("Lock", "RLock", "Condition"):
-                    continue
-                for tgt in node.targets:
-                    if (
-                        isinstance(tgt, ast.Attribute)
-                        and isinstance(tgt.value, ast.Name)
-                        and tgt.value.id == "self"
-                    ):
-                        locks.add(tgt.attr)
-    return locks
-
-
-def _self_attr_target(node: ast.expr) -> Optional[str]:
-    """`self.x` or `self.x[...]` as an assignment target -> "x"."""
-    if isinstance(node, ast.Subscript):
-        node = node.value
-    if (
-        isinstance(node, ast.Attribute)
-        and isinstance(node.value, ast.Name)
-        and node.value.id == "self"
-    ):
-        return node.attr
-    return None
-
-
-def _assigned_attrs(node: ast.stmt) -> List[Tuple[str, int]]:
-    out = []
-    if isinstance(node, ast.Assign):
-        for tgt in node.targets:
-            attr = _self_attr_target(tgt)
-            if attr:
-                out.append((attr, node.lineno))
-    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
-        attr = _self_attr_target(node.target)
-        if attr:
-            out.append((attr, node.lineno))
-    return out
-
-
-def _with_holds_lock(node: ast.With, locks: Set[str]) -> bool:
-    for item in node.items:
-        expr = item.context_expr
-        if isinstance(expr, ast.Call):  # self._lock.acquire-style helpers
-            expr = expr.func
-        attr = _self_attr_target(expr) if not isinstance(expr, ast.Call) else None
-        if attr in locks:
-            return True
-    return False
-
-
-@rule(
-    "serve-lock-discipline",
-    "serve/ class attribute that is written under the class lock in one "
-    "place but mutated outside it in another",
-    applies=lambda p: p.startswith("ytklearn_tpu/serve/"),
-)
-def serve_lock_discipline(ctx) -> Iterable[Tuple[int, str]]:
-    for cls in ast.walk(ctx.tree):
-        if not isinstance(cls, ast.ClassDef):
-            continue
-        locks = _lock_attrs(cls)
-        if not locks:
-            continue
-        guarded: Set[str] = set()  # attrs ever assigned under a lock
-        unguarded: List[Tuple[str, int, str]] = []
-        for method in cls.body:
-            if not isinstance(method, ast.FunctionDef):
-                continue
-            # collect line ranges covered by with-lock blocks
-            locked_lines: Set[int] = set()
-            for node in ast.walk(method):
-                if isinstance(node, ast.With) and _with_holds_lock(node, locks):
-                    locked_lines.update(
-                        range(node.lineno, (node.end_lineno or node.lineno) + 1)
-                    )
-            for node in ast.walk(method):
-                if not isinstance(node, (ast.Assign, ast.AugAssign,
-                                         ast.AnnAssign)):
-                    continue
-                for attr, line in _assigned_attrs(node):
-                    if attr in locks:
-                        continue
-                    if line in locked_lines:
-                        guarded.add(attr)
-                    elif method.name != "__init__":
-                        unguarded.append((attr, line, method.name))
-        for attr, line, meth in unguarded:
-            if attr in guarded:
-                yield (line,
-                       f"self.{attr} is written under the lock elsewhere in "
-                       f"`{cls.name}` but mutated without it in "
-                       f"`{meth}` — take the lock or document why "
-                       "this write cannot race")

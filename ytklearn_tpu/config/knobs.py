@@ -232,6 +232,11 @@ _knob("YTK_HIGGS_DIR", "str", None,
 _knob("YTK_REF", "str", "/root/reference",
       "path to the reference checkout used by reference-gated tests and "
       "benches", scope="test")
+_knob("YTK_LOCKWATCH_HOLD_MS", "float", 1000.0,
+      "lock hold-time budget (ms) for `pytest --ytk-lockwatch`: a "
+      "watched lock held longer fails the `@pytest.mark.threaded` test "
+      "(the runtime twin of ytklint blocking-call-under-lock)",
+      scope="test")
 
 
 # ---------------------------------------------------------------------------

@@ -1121,7 +1121,6 @@ class GBDTTrainer:
             # profiler here or the very run being profiled loses its trace
             try:
                 jax.profiler.stop_trace()
-            # ytklint: allow(broad-except) reason=a profiler teardown failure must not block the emergency checkpoint exit
             except Exception as e:
                 log.warning("profiler stop at preemption failed: %s", e)
         self._guard.preempt(

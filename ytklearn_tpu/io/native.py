@@ -77,6 +77,7 @@ def _load():
                      or os.path.getmtime(_SO) < os.path.getmtime(_SRC))
         except OSError:
             stale = True
+        # ytklint: allow(blocking-call-under-lock) reason=first-touch build serialization is the point — every ingest thread must wait for the ONE compiler run instead of racing N compiles of the same .so
         if stale and not _build():
             _lib_failed = True
             return None

@@ -321,6 +321,12 @@ def uninstall() -> None:
             _state.installed = False
         with core.REGISTRY._lock:
             core.REGISTRY.ring = None
-        _state.abnormal = False
-        _state.last_dump_path = None
-        _state.config_fingerprint = None
+    # the crash-path flags are LOCKLESS state by design: signal handlers
+    # and the excepthook write them and a handler must never take a lock
+    # (the interrupted thread may hold it — instant deadlock). Resetting
+    # them under _install_lock above would make them look lock-guarded
+    # (ytklint unguarded-shared-write) when the lock never actually
+    # protected them; single-reference stores are atomic under the GIL.
+    _state.abnormal = False
+    _state.last_dump_path = None
+    _state.config_fingerprint = None

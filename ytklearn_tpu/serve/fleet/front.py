@@ -127,7 +127,14 @@ class FleetFront:
         self._inflight_lock = threading.Lock()
         self._strikes: Dict[int, int] = {}
         self._restart_not_before: Dict[int, float] = {}
+        # async-respawn threads by slot: the MONITOR thread inserts while
+        # stop() (main thread or a signal-handler thread) sweeps the dict
+        # to join them — an insert landing mid-iteration is a
+        # RuntimeError("dictionary changed size during iteration") that
+        # would abort the drain and orphan the freshly-spawned worker, so
+        # both sides hold one lock (ytklint unguarded-shared-write)
         self._respawns: Dict[int, threading.Thread] = {}
+        self._respawns_lock = threading.Lock()
         self.latency = None  # front-side client-visible ring, set in start()
         self.draining = False
         self._closing = False
@@ -151,6 +158,7 @@ class FleetFront:
                     self.worker_argv, rid, env=None, log_dir=self.log_dir,
                     ready_timeout_s=self.ready_timeout_s,
                 )
+                # ytklint: allow(unguarded-shared-write) reason=every _spawn thread is joined below before the monitor/balancer/listener exist; after start() the dict shape is frozen — dead slots heal IN PLACE via spawn_replica(handle=h)
                 self.handles[rid] = h
             except Exception as e:  # noqa: BLE001 — collected and re-raised below
                 errors[rid] = e
@@ -194,7 +202,9 @@ class FleetFront:
             self._monitor.join(timeout=10.0)
         # in-flight respawns see _closing (spawn abort + early h.proc
         # publication) — join them so no freshly-spawned worker outlives us
-        for t in self._respawns.values():
+        with self._respawns_lock:
+            respawns = list(self._respawns.values())
+        for t in respawns:
             t.join(timeout=15.0)
         for f in self._forwarders.values():
             f.close(drain=drain, timeout=timeout)
@@ -476,8 +486,12 @@ class FleetFront:
             target=self._do_restart, args=(rid, h),
             name=f"ytk-fleet-respawn-{rid}", daemon=True,
         )
-        self._respawns[rid] = t
-        t.start()
+        with self._respawns_lock:
+            # publish AND start under the lock: a stop() sweep that
+            # snapshots after the insert must never join a not-yet-
+            # started thread (RuntimeError) — start() is sub-ms
+            self._respawns[rid] = t
+            t.start()
 
     def _do_restart(self, rid: int, h: ReplicaHandle) -> None:
         # reap the corpse before respawning the slot
