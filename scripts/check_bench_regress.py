@@ -12,13 +12,24 @@ Comparable = both artifacts parse to a bench record (the CI driver
 wrapper's "parsed" block or a raw bench line) AND report the same
 "metric" — a linear-era artifact is never compared against a GBDT one.
 
-Serve gate: SERVE_r*.json artifacts (scripts/serve_bench.py --record,
-schema "serve_latency") are compared on the same-metric newest pair too,
-but on the latency axes that matter for serving:
+Serve gate: SERVE_r*.json artifacts (scripts/serve_bench.py --record;
+schema "serve_latency", or "serve_rungs" whose artifact carries one
+record PER scoring rung) are compared on the latency axes that matter
+for serving — but ONLY between records with the same metric AND the same
+rung identity (fused, binned, precision): a binned-rung number vs a
+default-path number is an uplift, not a regression signal, exactly like
+the fleet gate's same-replica-count rule. Pre-rung artifacts count as
+the default rung, so the schema bump never breaks the gate; downgraded
+rung runs (a Mosaic fallback measured on its fallback path) skip.
 
   sustained req/s       new >= old * (1 - tol)
   p99 latency           new <= old * (1 + tol)   (the latency band)
   retraces_after_warmup must stay 0
+
+Rung quality gate: the newest serve_rungs artifact's recorded quality
+bands are re-checked absolutely — binned request-stream band under
+SERVE_BINNED_BAND, every bf16 family band under SERVE_BF16_BAND — so a
+relaxed-precision rung can never quietly ship outside its envelope.
 
 Fleet gate: schema "serve_fleet" artifacts (schema_version 2,
 `serve_bench.py --fleet`) are a different workload — N replica processes
@@ -143,87 +154,212 @@ def find_serve_artifacts(repo: str) -> List[Tuple[int, str]]:
     return sorted(out)
 
 
-def read_serve_record(path: str) -> dict:
-    """Normalize a serve_latency artifact (raw or CI-driver-wrapped)."""
+DEFAULT_RUNG = {"fused": False, "binned": False, "precision": "f64"}
+
+
+def _rung_of(rec: dict) -> tuple:
+    """(fused, binned, precision) identity — pre-rung artifacts ran the
+    default path, so missing fields mean the default rung."""
+    return (
+        bool(rec.get("fused", False)),
+        bool(rec.get("binned", False)),
+        str(rec.get("precision", "f64")),
+    )
+
+
+def read_serve_records(path: str) -> List[dict]:
+    """Normalized single-process serve records from one artifact (raw or
+    CI-driver-wrapped): a serve_latency artifact yields one default-rung
+    record; a serve_rungs artifact yields one record PER rung. Records
+    are only comparable at the same (metric, rung) — the r14
+    same-replica-count rule applied to the precision/fused axis."""
     import json
 
     with open(path) as f:
         rec = json.load(f)
     if "parsed" in rec and "cmd" in rec:  # CI driver wrapper
         rec = rec["parsed"] or {}
-    if rec.get("schema") != "serve_latency":
-        return {}
-    return {
-        "metric": rec.get("metric"),
-        "req_per_sec": rec.get("value"),
-        "p99_ms": rec.get("p99_ms"),
-        "retraces": rec.get("retraces_after_warmup"),
-        "raw": rec,
-    }
+    if rec.get("schema") == "serve_latency":
+        return [{
+            "metric": rec.get("metric"),
+            "rung": _rung_of({}),
+            "label": "default",
+            "req_per_sec": rec.get("value"),
+            "p99_ms": rec.get("p99_ms"),
+            "retraces": rec.get("retraces_after_warmup"),
+            "raw": rec,
+        }]
+    if rec.get("schema") == "serve_rungs":
+        out = []
+        for entry in rec.get("rungs") or []:
+            out.append({
+                "metric": rec.get("metric"),
+                "rung": _rung_of(entry),
+                "label": entry.get("rung"),
+                "req_per_sec": entry.get("req_per_sec"),
+                "p99_ms": entry.get("p99_ms"),
+                "retraces": entry.get("retraces_after_warmup"),
+                "downgraded": entry.get("downgraded", False),
+                "raw": rec,
+            })
+        return out
+    return []
 
 
-def serve_comparable_pair(artifacts: List[Tuple[int, str]]):
-    usable = []
+def serve_comparable_pairs(artifacts: List[Tuple[int, str]]):
+    """[(old, new)] — for EVERY rung record in the newest serve artifact,
+    the nearest older record with the same (metric, rung). Rungs with no
+    same-rung predecessor (first artifact after a rung ships, or a
+    downgraded rung measured as its fallback) skip cleanly."""
+    per_artifact = []
     for rnd, path in artifacts:
         try:
-            rec = read_serve_record(path)
+            recs = [
+                r for r in read_serve_records(path)
+                if r.get("metric") and r.get("req_per_sec") is not None
+            ]
         except Exception as e:  # noqa: BLE001 — a rotten artifact is a skip
             print(f"  [skip] {os.path.basename(path)}: unreadable ({e})")
             continue
-        if rec.get("metric") and rec.get("req_per_sec") is not None:
-            usable.append((rnd, path, rec))
+        if recs:
+            per_artifact.append((rnd, path, recs))
         else:
-            print(f"  [skip] {os.path.basename(path)}: not a serve_latency record")
-    if len(usable) < 2:
-        return None
-    newest = usable[-1]
-    for older in reversed(usable[:-1]):
-        if older[2]["metric"] == newest[2]["metric"]:
-            return older, newest
-    return None
+            print(f"  [skip] {os.path.basename(path)}: no serve records")
+    if len(per_artifact) < 2:
+        return []
+    n_rnd, n_path, newest = per_artifact[-1]
+    pairs = []
+    for rec in newest:
+        if rec.get("downgraded"):
+            # a downgraded rung ran its FALLBACK path; its number is not
+            # this rung's signal (the fallback is gated via its own rung)
+            print(
+                f"  [skip] r{n_rnd} rung {rec['label']}: downgraded run"
+            )
+            continue
+        for o_rnd, o_path, older in reversed(per_artifact[:-1]):
+            match = next(
+                (o for o in older
+                 if o["metric"] == rec["metric"]
+                 and o["rung"] == rec["rung"]
+                 and not o.get("downgraded")),
+                None,
+            )
+            if match is not None:
+                pairs.append(
+                    ((o_rnd, o_path, match), (n_rnd, n_path, rec))
+                )
+                break
+        else:
+            print(
+                f"  [skip] r{n_rnd} rung {rec['label']}: no same-rung "
+                "predecessor"
+            )
+    return pairs
 
 
-def read_fleet_record(path: str) -> dict:
-    """Normalize a serve_fleet artifact (raw or CI-driver-wrapped);
-    {} for anything else (incl. pre-fleet serve_latency records)."""
+def read_fleet_records(path: str) -> List[dict]:
+    """Normalized fleet records: a serve_fleet artifact (legacy, default
+    rung), or the fleet run embedded in a serve_rungs artifact (rung
+    fields carried). [] for anything else."""
     import json
 
     with open(path) as f:
         rec = json.load(f)
     if "parsed" in rec and "cmd" in rec:  # CI driver wrapper
         rec = rec["parsed"] or {}
-    if rec.get("schema") != "serve_fleet":
-        return {}
-    return {
-        "metric": rec.get("metric"),
-        "replicas": rec.get("replicas"),
-        "req_per_sec": rec.get("value"),
-        "p99_ms": rec.get("p99_ms"),
-        "retraces": rec.get("retraces_fleet"),
-        "raw": rec,
-    }
+    if rec.get("schema") == "serve_fleet":
+        return [{
+            "metric": rec.get("metric"),
+            "rung": _rung_of({}),
+            "replicas": rec.get("replicas"),
+            "req_per_sec": rec.get("value"),
+            "p99_ms": rec.get("p99_ms"),
+            "retraces": rec.get("retraces_fleet"),
+            "raw": rec,
+        }]
+    if rec.get("schema") == "serve_rungs" and rec.get("fleet"):
+        f_rec = rec["fleet"]
+        return [{
+            "metric": f_rec.get("metric"),
+            "rung": _rung_of(f_rec),
+            "replicas": f_rec.get("replicas"),
+            "req_per_sec": f_rec.get("req_per_sec"),
+            "p99_ms": f_rec.get("p99_ms"),
+            "retraces": f_rec.get("retraces_fleet"),
+            "raw": rec,
+        }]
+    return []
 
 
 def fleet_comparable_pair(artifacts: List[Tuple[int, str]]):
-    """Newest two fleet records sharing (metric, replica count) — a fleet
-    number is only comparable at the same fan-out."""
+    """Newest two fleet records sharing (metric, replica count, rung) — a
+    fleet number is only comparable at the same fan-out AND the same
+    scoring rung (a binned fleet vs a default fleet is an uplift, not a
+    regression signal)."""
     usable = []
     for rnd, path in artifacts:
         try:
-            rec = read_fleet_record(path)
+            recs = read_fleet_records(path)
         except Exception as e:  # noqa: BLE001 — a rotten artifact is a skip
             print(f"  [skip] {os.path.basename(path)}: unreadable ({e})")
             continue
-        if rec.get("metric") and rec.get("req_per_sec") is not None:
-            usable.append((rnd, path, rec))
+        for rec in recs:
+            if rec.get("metric") and rec.get("req_per_sec") is not None:
+                usable.append((rnd, path, rec))
     if len(usable) < 2:
         return None
     newest = usable[-1]
     for older in reversed(usable[:-1]):
         if (older[2]["metric"] == newest[2]["metric"]
-                and older[2]["replicas"] == newest[2]["replicas"]):
+                and older[2]["replicas"] == newest[2]["replicas"]
+                and older[2]["rung"] == newest[2]["rung"]):
             return older, newest
     return None
+
+
+def check_rung_quality(artifacts: List[Tuple[int, str]]) -> List[str]:
+    """Absolute quality-band gate on the NEWEST serve_rungs artifact:
+    the binned rung's request-stream band and every bf16 family band must
+    stay inside the same envelopes serve_bench enforces at record time
+    (env SERVE_BINNED_BAND / SERVE_BF16_BAND)."""
+    import json
+
+    binned_band = float(os.environ.get("SERVE_BINNED_BAND", "1e-9"))
+    bf16_band = float(os.environ.get("SERVE_BF16_BAND", "0.1"))
+    for rnd, path in reversed(artifacts):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if "parsed" in rec and "cmd" in rec:
+            rec = rec["parsed"] or {}
+        if rec.get("schema") != "serve_rungs":
+            continue
+        fails = []
+        quality = rec.get("binned_quality") or {}
+        band = quality.get("max_abs_pred_diff")
+        if band is not None:
+            print(f"  rung quality (r{rnd}): binned stream band {band:.3g} "
+                  f"(limit {binned_band:.3g})")
+            if band > binned_band:
+                fails.append(
+                    f"binned rung quality band {band:.3g} > "
+                    f"{binned_band:.3g} in {os.path.basename(path)} "
+                    "(env SERVE_BINNED_BAND)"
+                )
+        for family, b in sorted((rec.get("precision_bands") or {}).items()):
+            print(f"  rung quality (r{rnd}): bf16 {family} band {b:.3g} "
+                  f"(limit {bf16_band:.3g})")
+            if b > bf16_band:
+                fails.append(
+                    f"bf16 band {b:.3g} > {bf16_band:.3g} for {family} in "
+                    f"{os.path.basename(path)} (env SERVE_BF16_BAND)"
+                )
+        return fails
+    print("  rung quality: no serve_rungs artifact (skip)")
+    return []
 
 
 def check_fleet(old, new, tol: float) -> List[str]:
@@ -262,17 +398,19 @@ def check_fleet(old, new, tol: float) -> List[str]:
 
 
 def check_serve(old, new, tol: float) -> List[str]:
-    """-> failure messages for the serve (latency-schema) pair."""
+    """-> failure messages for one same-(metric, rung) serve pair."""
     (o_rnd, _o_path, o), (n_rnd, _n_path, n) = old, new
     fails = []
+    label = n.get("label", "default")
     floor = o["req_per_sec"] * (1.0 - tol)
     print(
-        f"  serve req/s: r{n_rnd} {n['req_per_sec']:.1f} vs r{o_rnd} "
-        f"{o['req_per_sec']:.1f} (floor {floor:.1f}, tol {tol:.0%})"
+        f"  serve req/s [{label}]: r{n_rnd} {n['req_per_sec']:.1f} vs "
+        f"r{o_rnd} {o['req_per_sec']:.1f} (floor {floor:.1f}, tol {tol:.0%})"
     )
     if n["req_per_sec"] < floor:
         fails.append(
-            f"serve throughput regressed: {n['req_per_sec']:.1f} < "
+            f"serve throughput regressed on the {label} rung: "
+            f"{n['req_per_sec']:.1f} < "
             f"{o['req_per_sec']:.1f} * (1 - {tol}) = {floor:.1f}"
         )
     if o.get("p99_ms") is not None and n.get("p99_ms") is not None:
@@ -401,17 +539,19 @@ def main(argv=None) -> int:
 
     serve_artifacts = find_serve_artifacts(args.dir)
     print(f"check_bench_regress: {len(serve_artifacts)} SERVE artifact(s)")
-    serve_pair = serve_comparable_pair(serve_artifacts)
-    if serve_pair is None:
-        print("check_bench_regress: SKIP serve gate (fewer than two "
-              "comparable artifacts)")
+    serve_pairs = serve_comparable_pairs(serve_artifacts)
+    if not serve_pairs:
+        print("check_bench_regress: SKIP serve gate (no same-rung "
+              "comparable pairs)")
     else:
-        fails += check_serve(*serve_pair, tol=args.tol)
+        for pair in serve_pairs:
+            fails += check_serve(*pair, tol=args.tol)
+    fails += check_rung_quality(serve_artifacts)
 
     fleet_pair = fleet_comparable_pair(serve_artifacts)
     if fleet_pair is None:
-        print("check_bench_regress: SKIP fleet gate (fewer than two "
-              "same-replica-count fleet artifacts)")
+        print("check_bench_regress: SKIP fleet gate (no same-(metric, "
+              "replicas, rung) fleet pair)")
     else:
         fails += check_fleet(*fleet_pair, tol=args.tol)
 

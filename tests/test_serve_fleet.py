@@ -381,6 +381,81 @@ def test_front_http_listener_and_unknown_model_404():
 
 
 # ---------------------------------------------------------------------------
+# raw-splice HTTP ingress (front.extract_raw_rows + the /predict handler)
+# ---------------------------------------------------------------------------
+
+
+def test_extract_raw_rows_shapes():
+    from ytklearn_tpu.serve.fleet.front import extract_raw_rows as ex
+
+    assert ex('{"rows":[{"a":1.5},{"b":2}]}') == ['{"a":1.5}', '{"b":2}']
+    # nested structures + brace-bearing strings survive verbatim
+    assert ex('{ "rows" : [ {"a": {"n": [1,2]}} , {"b":"}] tricky"} ] }') \
+        == ['{"a": {"n": [1,2]}}', '{"b":"}] tricky"}']
+    # a row FEATURE named "rows" is not the top-level key
+    assert ex('{"rows":[{"rows":[1]}]}') == ['{"rows":[1]}']
+    # anything beyond the strict hot shape falls back to the general parse
+    assert ex('{"rows":[{"a":1}],"model":"m"}') is None
+    assert ex('{"model":"m","rows":[{"a":1}]}') is None
+    assert ex('{"features":{"a":1}}') is None
+    assert ex('{"rows":[]}') is None
+    assert ex('{"rows":[1,2]}') is None
+    assert ex('{"rows":[{"a":1}]') is None
+    assert ex('{"rows":[{"a":1}]}garbage') is None
+    assert ex("") is None
+
+
+def test_front_http_raw_splice_ingress(obs_on):
+    """The front's own /predict handler splices the client's raw `"rows"`
+    bytes into forward bodies: same answers as the dict path, counted by
+    serve.front.raw_splice; non-strict bodies take the general path."""
+    import urllib.error
+    import urllib.request
+
+    front = _stub_front(replicas=1).start().serve_http()
+
+    def _post(body: str):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{front.port}/predict",
+            data=body.encode(),
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=15.0) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    def _splices():
+        return obs.REGISTRY.counters.get("serve.front.raw_splice", 0.0)
+
+    try:
+        body = '{"rows":[{"x": 3.0},{"x": 1.0, "y": 2.0}]}'
+        before = _splices()
+        code, out = _post(body)
+        assert code == 200
+        # stub scoring: weight(2.0) * sum(values) per row
+        assert out["scores"] == pytest.approx([6.0, 6.0])
+        assert _splices() == before + 1
+        assert obs.REGISTRY.counters.get(
+            "serve.front.raw_splice_rows", 0.0) >= 2
+        # extra key -> general parse path, same answer, no splice count
+        before = _splices()
+        code, out2 = _post(
+            '{"rows":[{"x": 3.0},{"x": 1.0, "y": 2.0}],"client":"t"}'
+        )
+        assert code == 200 and out2["scores"] == out["scores"]
+        assert _splices() == before
+        # malformed rows still 400 (validation parity)
+        code, err = _post('{"rows":[{"x": 3.0}, 7]}')
+        assert code == 400 and err["type"] == "bad_request"
+        code, err = _post('{"rows":')
+        assert code == 400
+    finally:
+        front.stop(drain=True, timeout=15.0)
+
+
+# ---------------------------------------------------------------------------
 # replica identity in obs + /metrics
 # ---------------------------------------------------------------------------
 

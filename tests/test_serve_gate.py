@@ -75,15 +75,140 @@ def test_gate_ignores_metric_mismatch_and_rot(tmp_path, capsys):
     assert "SKIP serve gate" in capsys.readouterr().out
 
 
+def _rung_entry(rung, qps, p99, retraces=0, downgraded=False,
+                precision="f64"):
+    return {
+        "rung": rung,
+        "fused": rung == "fused",
+        "binned": rung == "binned",
+        "precision": precision,
+        "req_per_sec": qps,
+        "p99_ms": p99,
+        "retraces_after_warmup": retraces,
+        "downgraded": downgraded,
+    }
+
+
+def _rungs_artifact(tmp_path, rnd, rungs, metric="serve_req_per_sec_x_gbdt",
+                    binned_band=0.0, bf16=None, fleet=None):
+    default = next(r for r in rungs if r["rung"] == "default")
+    rec = {
+        "schema_version": 3,
+        "schema": "serve_rungs",
+        "metric": metric,
+        "value": default["req_per_sec"],
+        "p99_ms": default["p99_ms"],
+        "retraces_after_warmup": default["retraces_after_warmup"],
+        "rungs": rungs,
+        "binned_quality": {"max_abs_pred_diff": binned_band},
+        "precision_bands": bf16 or {"linear": 0.007, "fm": 0.05},
+    }
+    if fleet is not None:
+        rec["fleet"] = fleet
+    (tmp_path / f"SERVE_r{rnd:02d}.json").write_text(json.dumps(rec))
+
+
+def test_gate_pairs_legacy_default_with_rungs_default(tmp_path, capsys):
+    """A serve_latency artifact is the default rung: it pairs with the
+    rungs artifact's default entry; the new rungs skip (no predecessor)."""
+    _serve_artifact(tmp_path, 9, 10000.0, 20.0, metric="serve_req_per_sec_x_gbdt")
+    _rungs_artifact(tmp_path, 16, [
+        _rung_entry("default", 10500.0, 19.0),
+        _rung_entry("fused", 10400.0, 20.0, downgraded=True),
+        _rung_entry("binned", 16000.0, 12.0),
+    ])
+    assert gate_main(["--dir", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "serve req/s [default]" in out
+    assert "rung binned: no same-rung predecessor" in out
+    assert "rung fused: downgraded run" in out
+
+
+def test_gate_fails_on_rung_regression(tmp_path, capsys):
+    _rungs_artifact(tmp_path, 16, [
+        _rung_entry("default", 10000.0, 20.0),
+        _rung_entry("binned", 16000.0, 12.0),
+    ])
+    _rungs_artifact(tmp_path, 17, [
+        _rung_entry("default", 10000.0, 20.0),
+        _rung_entry("binned", 9000.0, 12.0),  # binned lost its uplift
+    ])
+    assert gate_main(["--dir", str(tmp_path)]) == 1
+    assert "binned rung" in capsys.readouterr().err
+
+
+def test_gate_fails_on_recorded_quality_band(tmp_path, capsys):
+    _rungs_artifact(tmp_path, 16, [
+        _rung_entry("default", 10000.0, 20.0),
+    ], binned_band=0.5)  # way outside SERVE_BINNED_BAND
+    assert gate_main(["--dir", str(tmp_path)]) == 1
+    assert "quality band" in capsys.readouterr().err
+
+
+def test_gate_fails_on_recorded_bf16_band(tmp_path, capsys):
+    _rungs_artifact(tmp_path, 16, [
+        _rung_entry("default", 10000.0, 20.0),
+    ], bf16={"ffm": 0.4})
+    assert gate_main(["--dir", str(tmp_path)]) == 1
+    assert "bf16 band" in capsys.readouterr().err
+
+
+def _fleet_artifact(tmp_path, rnd, qps, p99, replicas=4,
+                    metric="serve_fleet_req_per_sec_x_gbdt"):
+    rec = {
+        "schema_version": 2,
+        "schema": "serve_fleet",
+        "metric": metric,
+        "value": qps,
+        "p99_ms": p99,
+        "replicas": replicas,
+        "retraces_fleet": 0,
+    }
+    (tmp_path / f"SERVE_r{rnd:02d}.json").write_text(json.dumps(rec))
+
+
+def test_fleet_gate_separates_rungs(tmp_path, capsys):
+    """A binned-rung fleet run embedded in a serve_rungs artifact never
+    pairs with a default-rung serve_fleet artifact (uplift != signal)."""
+    _fleet_artifact(tmp_path, 14, 45000.0, 60.0)
+    _rungs_artifact(tmp_path, 16, [
+        _rung_entry("default", 10000.0, 20.0),
+    ], fleet={
+        "metric": "serve_fleet_req_per_sec_x_gbdt",
+        "replicas": 4, "binned": True, "fused": False, "precision": "f64",
+        "req_per_sec": 20000.0, "p99_ms": 90.0, "retraces_fleet": 0,
+    })
+    assert gate_main(["--dir", str(tmp_path)]) == 0
+    assert "SKIP fleet gate" in capsys.readouterr().out
+
+
+def test_fleet_gate_compares_same_rung(tmp_path, capsys):
+    def binned_fleet(qps):
+        return {
+            "metric": "serve_fleet_req_per_sec_x_gbdt",
+            "replicas": 4, "binned": True, "fused": False,
+            "precision": "f64",
+            "req_per_sec": qps, "p99_ms": 50.0, "retraces_fleet": 0,
+        }
+
+    _rungs_artifact(tmp_path, 16, [_rung_entry("default", 10000.0, 20.0)],
+                    fleet=binned_fleet(60000.0))
+    _rungs_artifact(tmp_path, 17, [_rung_entry("default", 10000.0, 20.0)],
+                    fleet=binned_fleet(20000.0))  # regressed
+    assert gate_main(["--dir", str(tmp_path)]) == 1
+    assert "fleet throughput regressed" in capsys.readouterr().err
+
+
 def test_gate_real_recorded_artifact_shape():
-    """The checked-in SERVE_r09.json parses as a serve_latency record."""
-    from check_bench_regress import read_serve_record
+    """The checked-in SERVE_r09.json parses as a default-rung record."""
+    from check_bench_regress import read_serve_records
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     path = os.path.join(repo, "SERVE_r09.json")
     if not os.path.exists(path):
         pytest.skip("no recorded serve artifact")
-    rec = read_serve_record(path)
+    (rec,) = read_serve_records(path)
     assert rec["metric"].startswith("serve_req_per_sec")
+    assert rec["rung"] == (False, False, "f64")
     assert rec["req_per_sec"] > 0 and rec["p99_ms"] > 0
     assert rec["retraces"] == 0

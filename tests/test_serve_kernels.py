@@ -1,0 +1,603 @@
+"""Fused serve-side inference kernels + precision rungs (serve/kernels.py).
+
+Off-TPU the fused Pallas kernels cannot compile, so — exactly like
+tests/test_hist_fused.py — the REAL kernel bodies run through the Pallas
+interpreter (`fused_interpret=True`) and are pinned against the stacked
+XLA path bit-for-bit (f64 fold order is identical by construction). The
+binned rung is covered in both table modes: ensemble-derived thresholds
+(bit-identical everywhere, boundaries included) and dumped training edges
+(interior-exact, boundary ties round UP like training), on every backend
+(Pallas interpreter / native C++ / XLA fallback). The downgrade chain is
+exercised the way production hits it: a real Mosaic failure on this CPU
+backend, with the named serve.downgrade.* counter and a server that keeps
+answering.
+"""
+
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+
+from serve_models import (
+    build_ffm,
+    build_fm,
+    build_gbdt,
+    build_linear,
+    build_multiclass,
+    request_rows,
+)
+from ytklearn_tpu import obs
+from ytklearn_tpu.gbdt.tree import GBDTModel, Tree
+from ytklearn_tpu.serve import CompiledScorer, kernels
+
+LADDER = (4, 32)
+
+
+@pytest.fixture()
+def obs_on():
+    obs.configure(enabled=True)
+    yield obs
+    obs.configure(enabled=False)
+
+
+@pytest.fixture(scope="module")
+def gbdt_case(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("serve_kernels")
+    pred, names = build_gbdt(tmp, n_trees=24, depth=4)
+    rng = np.random.RandomState(3)
+    rows = request_rows(64, rng, names=names)
+    return pred, names, rows
+
+
+def _counter(name):
+    return obs.REGISTRY.counters.get(name, 0.0)
+
+
+# ---------------------------------------------------------------------------
+# Kernel-layout (heap) export
+# ---------------------------------------------------------------------------
+
+
+def test_heap_arrays_routing_matches_tree_walk():
+    """Arbitrary-topology tree -> heap layout: a branchless positional
+    walk must land on the same leaf value as the pointer walk, for dense,
+    sparse, and missing rows."""
+    rng = np.random.RandomState(0)
+    t = Tree()
+    t.feat[0] = 0
+    t.feat_name[0] = "a"
+    t.split[0] = 0.0
+    left, right = t.add_children(0)
+    t.feat[left] = 1
+    t.feat_name[left] = "b"
+    t.split[left] = -0.5
+    t.default_left[left] = False
+    ll, lr = t.add_children(left)
+    t.leaf_value[ll] = 1.0
+    t.leaf_value[lr] = 2.0
+    t.leaf_value[right] = 3.0  # leaf one level ABOVE max depth
+    depth = 2
+    arrs = t.heap_arrays(depth, feat_ids=t.feat)
+    LL = 1 << depth
+
+    def walk(av, bv):
+        pos = 0
+        for _ in range(depth):
+            f = arrs["feat"][pos]
+            v = av if f == 0 else bv
+            if v is None or math.isnan(v):
+                go_left = arrs["dleft"][pos] > 0
+            else:
+                go_left = v <= arrs["split"][pos]
+            pos = 2 * pos + 2 - int(go_left)
+        return arrs["leaf"][pos - (LL - 1)]
+
+    for av, bv in [(-1.0, -1.0), (-1.0, 0.0), (1.0, 5.0), (np.nan, -1.0),
+                   (-1.0, np.nan), (0.0, -0.5)]:
+        feats = {}
+        if av is not None and not math.isnan(av):
+            feats["a"] = av
+        if bv is not None and not math.isnan(bv):
+            feats["b"] = bv
+        nid = 0
+        while not t.is_leaf(nid):
+            v = feats.get(t.feat_name[nid])
+            go_left = t.default_left[nid] if v is None else v <= t.split[nid]
+            nid = t.left[nid] if go_left else t.right[nid]
+        assert walk(av, bv) == t.leaf_value[nid]
+
+
+def test_heap_pad_trees_are_negative_zero():
+    """T padded to the tree-block multiple with -0.0 leaves: x + (-0.0)
+    is x for EVERY x, so the fold stays bit-exact."""
+    t = Tree()
+    t.leaf_value[0] = -0.25
+    heap, why = kernels.build_heap([t], {"a": 0}, pad_trees_to=8)
+    assert heap is not None, why
+    assert heap.feat.shape[0] == 8 and heap.n_trees == 1
+    pads = heap.leaf[1:]
+    assert np.all(pads == 0.0)
+    assert np.all(np.signbit(pads))  # -0.0, not +0.0
+
+
+def test_build_heap_refusals():
+    t = Tree()  # single leaf
+    assert kernels.build_heap([], {"a": 0})[0] is None
+    assert kernels.build_heap([t], {})[0] is None  # no split features
+    deep = Tree()
+    nid = 0
+    for i in range(kernels.HEAP_DEPTH_CAP + 1):  # left spine past the cap
+        deep.feat[nid] = 0
+        deep.feat_name[nid] = "a"
+        deep.split[nid] = float(i)
+        nid, _ = deep.add_children(nid)
+    heap, why = kernels.build_heap([deep], {"a": 0})
+    assert heap is None and "depth" in why
+
+
+# ---------------------------------------------------------------------------
+# Fused rung: Pallas interpreter vs the stacked XLA path (bit-identity)
+# ---------------------------------------------------------------------------
+
+
+def test_fused_interpret_bit_identical_to_stacked(gbdt_case):
+    pred, _names, rows = gbdt_case
+    want = pred.batch_scores(rows)
+    stacked = CompiledScorer(pred, ladder=LADDER)
+    fused = CompiledScorer(pred, ladder=LADDER, mode="fused",
+                           fused_interpret=True)
+    assert fused.rung_info()["backend"] == "fused-pallas-interpret"
+    assert not fused.rung_info()["downgraded"]
+    np.testing.assert_array_equal(stacked.score_batch(rows), want)
+    np.testing.assert_array_equal(fused.score_batch(rows), want)
+    # predictions ride the same activation
+    np.testing.assert_array_equal(
+        fused.predict_batch(rows), stacked.predict_batch(rows)
+    )
+
+
+def test_fused_interpret_missing_routing(gbdt_case):
+    """Rows with every feature absent exercise the default-direction path
+    through the one-hot walk (NaN fill -> dleft)."""
+    pred, _names, _rows = gbdt_case
+    fused = CompiledScorer(pred, ladder=(4,), mode="fused",
+                           fused_interpret=True)
+    empty = [{} for _ in range(4)]
+    np.testing.assert_array_equal(
+        fused.score_batch(empty), pred.batch_scores(empty)
+    )
+
+
+# ---------------------------------------------------------------------------
+# Binned rung — thresholds mode (no sidecar): exact EVERYWHERE
+# ---------------------------------------------------------------------------
+
+
+def _boundary_rows(pred, n=64):
+    out = []
+    for t in pred.model.trees:
+        for nid in range(t.n_nodes()):
+            if not t.is_leaf(nid):
+                out.append({t.feat_name[nid]: float(t.split[nid])})
+            if len(out) >= n:
+                return out
+    return out
+
+
+@pytest.mark.parametrize("backend_env", ["native", "xla"])
+def test_binned_thresholds_exact_incl_boundaries(
+    gbdt_case, backend_env, monkeypatch
+):
+    """Without a sidecar the bin table is the ensemble's own thresholds:
+    `bin < rank+1` IS `value <= split`, so scores are bit-identical even
+    for rows planted exactly ON split values — on the native and the XLA
+    backend alike."""
+    if backend_env == "xla":
+        # the loaded .so is cached module-wide; force the XLA fallback
+        monkeypatch.setattr(kernels, "_lib", None)
+        monkeypatch.setattr(kernels, "_lib_failed", True)
+    pred, _names, rows = gbdt_case
+    scorer = CompiledScorer(pred, ladder=LADDER, mode="binned")
+    info = scorer.rung_info()
+    assert info["bin_mode"] == "thresholds"
+    assert info["backend"] == (
+        "binned-native" if backend_env == "native" else "binned-xla"
+    )
+    probe = rows + _boundary_rows(pred)
+    np.testing.assert_array_equal(
+        scorer.score_batch(probe), pred.batch_scores(probe)
+    )
+
+
+def test_binned_pallas_interpret_matches_native(gbdt_case):
+    pred, _names, rows = gbdt_case
+    a = CompiledScorer(pred, ladder=(32,), mode="binned")
+    b = CompiledScorer(pred, ladder=(32,), mode="binned",
+                       fused_interpret=True)
+    assert b.rung_info()["backend"] == "binned-pallas-interpret"
+    probe = rows + _boundary_rows(pred)
+    np.testing.assert_array_equal(
+        a.score_batch(probe), b.score_batch(probe)
+    )
+
+
+def test_featurize_tolerates_nonnumeric_unknown_feature(gbdt_case):
+    """The C-speed featurize path must keep the slow path's contract: an
+    unknown feature is dropped BEFORE any float conversion, so a client
+    tagging rows with e.g. a trace-id string still scores."""
+    pred, _names, _rows = gbdt_case
+    scorer = CompiledScorer(pred, ladder=(4,))
+    rows = [{"c0": 0.5, "trace_id": "abc"}, {"c1": -1.0}]
+    np.testing.assert_array_equal(
+        scorer.score_batch(rows), pred.batch_scores(rows)
+    )
+    # a KNOWN feature's non-numeric value still raises (old behavior)
+    with pytest.raises((ValueError, TypeError)):
+        scorer.score_batch([{"c0": "abc"}])
+
+
+def test_bin_rows_edges_rule_matches_bin_matrix():
+    """Serve-side edges binning re-states bin_matrix's rule in f64 (the
+    training matrix is f32; the native twin needs f64) — this pins the
+    two against each other on exactly-f32-representable values so a
+    change to the training tie rule cannot silently diverge serving."""
+    from ytklearn_tpu.gbdt.binning import FeatureBins, bin_matrix
+
+    rng = np.random.RandomState(13)
+    F, B, cnt = 4, 256, 9
+    edges = np.tile(np.linspace(-4.0, 4.0, cnt), (F, 1))  # exact in f32
+    # values: on-edge, midpoint (tie), interior, out-of-range, NaN
+    X = rng.choice(
+        np.arange(-6.0, 6.0, 0.25), size=(B, F), replace=True
+    ).astype(np.float64)
+    X[rng.rand(B, F) < 0.1] = np.nan
+    fb = FeatureBins(values=edges.astype(np.float32),
+                     counts=np.full(F, edges.shape[1], np.int32),
+                     max_bins=edges.shape[1])
+    table = kernels.BinTable(
+        values=[e.astype(np.float64) for e in edges], mode="edges",
+        dtype=np.dtype(np.uint8), sentinel=0xFF,
+    )
+    got = kernels.bin_rows(X, table)
+    want = bin_matrix(X, fb).astype(np.int64)
+    nan = np.isnan(X)
+    np.testing.assert_array_equal(got[~nan].astype(np.int64), want[~nan])
+    assert np.all(got[nan] == 0xFF)
+
+
+def test_bin_rows_native_matches_numpy(gbdt_case, monkeypatch):
+    """The C binning entry must land on the numpy fallback's exact bins
+    (both modes, NaN sentinel included)."""
+    pred, _names, rows = gbdt_case
+    scorer = CompiledScorer(pred, ladder=(4,), mode="binned", warmup=False)
+    table = scorer._bin_table
+    X = scorer.featurize(rows)
+    got = kernels.bin_rows(X, table)
+    # numpy fallback: pretend the lib is unavailable
+    monkeypatch.setattr(kernels, "_lib", None)
+    monkeypatch.setattr(kernels, "_lib_failed", True)
+    want = kernels.bin_rows(X, table)
+    np.testing.assert_array_equal(got, want)
+    assert got.dtype == table.dtype
+    assert np.all(got[np.isnan(X)] == table.sentinel)
+
+
+# ---------------------------------------------------------------------------
+# Binned rung — edges mode (dumped sidecar): interior-exact, ties round up
+# ---------------------------------------------------------------------------
+
+
+def _edges_model(tmp_path):
+    """Model whose splits are exactly the adjacent-representative
+    midpoints of a known edge table (what the trainer dumps)."""
+    edges = {
+        "a": np.asarray([-1.0, 0.0, 1.0, 2.0], np.float64),
+        "b": np.asarray([-2.0, 0.5, 3.0], np.float64),
+    }
+
+    def mid(name, lo):
+        e = edges[name]
+        return 0.5 * (e[lo] + e[lo + 1])
+
+    t = Tree()
+    t.feat[0] = 0
+    t.feat_name[0] = "a"
+    t.split[0] = mid("a", 1)  # 0.5
+    left, right = t.add_children(0)
+    t.feat[left] = 1
+    t.feat_name[left] = "b"
+    t.split[left] = mid("b", 0)  # -0.75
+    ll, lr = t.add_children(left)
+    t.leaf_value[ll] = 1.0
+    t.leaf_value[lr] = 2.0
+    t.feat[right] = 0
+    t.feat_name[right] = "a"
+    t.split[right] = mid("a", 2)  # 1.5
+    rl, rr = t.add_children(right)
+    t.leaf_value[rl] = 4.0
+    t.leaf_value[rr] = 8.0
+    model = GBDTModel(base_prediction=0.0, num_tree_in_group=1,
+                      obj_name="sigmoid", trees=[t])
+    path = tmp_path / "edges.model"
+    path.write_text(model.dumps())
+    from ytklearn_tpu.gbdt.binning import dump_bin_edges
+
+    class _FB:
+        def __init__(self, e):
+            names = sorted(e)
+            width = max(len(e[n]) for n in names)
+            self.values = np.zeros((len(names), width), np.float32)
+            self.counts = np.zeros((len(names),), np.int32)
+            for i, n in enumerate(names):
+                self.values[i, : len(e[n])] = e[n]
+                self.values[i, len(e[n]):] = e[n][-1]
+                self.counts[i] = len(e[n])
+
+    from ytklearn_tpu.io.fs import LocalFileSystem
+
+    fs = LocalFileSystem()
+    dump_bin_edges(fs, str(path) + ".bins.json", sorted(edges), _FB(edges))
+    from ytklearn_tpu.predict import create_predictor
+
+    cfg = {"model": {"data_path": str(path)},
+           "optimization": {"loss_function": "sigmoid"}}
+    return create_predictor("gbdt", cfg), edges
+
+
+def test_binned_edges_interior_exact_boundary_ties_up(tmp_path):
+    pred, edges = _edges_model(tmp_path)
+    scorer = CompiledScorer(pred, ladder=(8,), mode="binned")
+    assert scorer.rung_info()["bin_mode"] == "edges"
+    # interior rows (away from edge midpoints): bit-identical to the
+    # float-compare host walk
+    rng = np.random.RandomState(5)
+    interior = [
+        {"a": float(rng.choice([-1.2, -0.3, 0.2, 0.9, 1.7, 2.6])),
+         "b": float(rng.choice([-3.0, -0.2, 1.0, 4.0]))}
+        for _ in range(32)
+    ]
+    np.testing.assert_array_equal(
+        scorer.score_batch(interior), pred.batch_scores(interior)
+    )
+    # boundary rows (value EXACTLY a split midpoint): training rounds the
+    # tie UP to the next representative -> routes right, while the float
+    # compare v <= split routes left. The binned score must equal scoring
+    # the rounded-up representative.
+    b_rows = [{"a": 0.5, "b": -3.0}]  # a == root split midpoint
+    got = scorer.score_batch(b_rows)
+    assert got[0] == pred.score({"a": 1.0, "b": -3.0})  # rep above the tie
+    assert got[0] != pred.score(b_rows[0])  # and NOT the float-path answer
+    # missing features still route via the default direction
+    np.testing.assert_array_equal(
+        scorer.score_batch([{}]), pred.batch_scores([{}])
+    )
+
+
+def test_stale_sidecar_falls_back_to_thresholds(tmp_path, caplog):
+    """Splits outside the dumped edge range = stale sidecar: binned must
+    derive thresholds (exact) instead of silently misrouting."""
+    pred, edges = _edges_model(tmp_path)
+    side = pred.params.model.data_path + ".bins.json"
+    payload = json.loads(open(side).read())
+    payload["features"]["a"] = [-0.1, 0.1]  # range excludes the real splits
+    with open(side, "w") as f:
+        json.dump(payload, f)
+    scorer = CompiledScorer(pred, ladder=(8,), mode="binned")
+    assert scorer.rung_info()["bin_mode"] == "thresholds"
+    rows = [{"a": v, "b": w} for v in (-1.5, 0.5, 1.5, 2.5)
+            for w in (-0.75, 0.0)]
+    np.testing.assert_array_equal(
+        scorer.score_batch(rows), pred.batch_scores(rows)
+    )
+
+
+def test_partial_sidecar_falls_back(tmp_path):
+    pred, _edges = _edges_model(tmp_path)
+    side = pred.params.model.data_path + ".bins.json"
+    payload = json.loads(open(side).read())
+    del payload["features"]["b"]
+    with open(side, "w") as f:
+        json.dump(payload, f)
+    scorer = CompiledScorer(pred, ladder=(8,), mode="binned")
+    assert scorer.rung_info()["bin_mode"] == "thresholds"
+
+
+# ---------------------------------------------------------------------------
+# Downgrade chain: Mosaic failure / unsupported shapes never kill serving
+# ---------------------------------------------------------------------------
+
+
+def test_fused_mosaic_failure_downgrades_named_counter(gbdt_case, obs_on):
+    """On this CPU backend the non-interpret Pallas probe IS the forced
+    Mosaic failure: the scorer must fall back to the stacked path, count
+    serve.downgrade.fused_to_stacked, and stay bit-identical."""
+    pred, _names, rows = gbdt_case
+    before = _counter("serve.downgrade.fused_to_stacked")
+    scorer = CompiledScorer(pred, ladder=LADDER, mode="fused")
+    info = scorer.rung_info()
+    assert info["downgraded"] and info["mode"] == "stacked"
+    assert _counter("serve.downgrade.fused_to_stacked") == before + 1
+    assert _counter("serve.downgrade.total") >= before + 1
+    events = [
+        e for e in obs.REGISTRY.events if e["name"] == "serve.downgrade"
+    ]
+    assert events and events[-1]["args"]["kind"] == "fused_to_stacked"
+    np.testing.assert_array_equal(
+        scorer.score_batch(rows), pred.batch_scores(rows)
+    )
+
+
+def test_multiclass_rungs_downgrade(tmp_path, obs_on):
+    """K>1 ensembles keep the stacked path (rungs are K==1 for now) —
+    loudly, not silently."""
+    pred, names = build_gbdt(tmp_path, n_trees=6, depth=2)
+    pred.K = pred.n_outputs = 2  # pretend two groups; arrays reshape
+    pred.use_rounds = 3
+    before = _counter("serve.downgrade.binned_to_stacked")
+    scorer = CompiledScorer(pred, ladder=(4,), mode="binned")
+    assert scorer.rung_info()["downgraded"]
+    assert _counter("serve.downgrade.binned_to_stacked") == before + 1
+    rows = request_rows(8, np.random.RandomState(0), names=names)
+    np.testing.assert_array_equal(
+        scorer.score_batch(rows), pred.batch_scores(rows)
+    )
+
+
+def test_server_stays_up_under_forced_downgrade(tmp_path, obs_on,
+                                                monkeypatch):
+    """ServeApp booted with YTK_SERVE_FUSED=1 on CPU: the probe fails,
+    the downgrade counter lands in /metrics, and /predict answers —
+    'Mosaic failure never kills a server'."""
+    monkeypatch.setenv("YTK_SERVE_FUSED", "1")
+    from test_serve import _http, _load_prebuilt
+    from ytklearn_tpu.serve import BatchPolicy, ModelRegistry, ServeApp
+
+    predictor, names = build_gbdt(tmp_path)
+    reg = ModelRegistry(ladder=(1, 4, 16), watch_interval_s=0)
+    _load_prebuilt(reg, "default", predictor)
+    app = ServeApp(reg, BatchPolicy(max_batch=16, max_wait_ms=1.0)).start()
+    try:
+        rows = request_rows(3, np.random.RandomState(1), names=names)
+        status, body = _http("POST", app.port, "/predict", {"rows": rows})
+        assert status == 200
+        np.testing.assert_allclose(
+            body["scores"], predictor.batch_scores(rows), rtol=0, atol=0
+        )
+        status, m = _http("GET", app.port, "/metrics")
+        assert status == 200
+        assert m["counters"].get("serve.downgrade.fused_to_stacked", 0) >= 1
+        rung = m["models"]["default"]["rung"]
+        assert rung["requested"] == "fused" and rung["mode"] == "stacked"
+    finally:
+        app.stop(drain=True, timeout=10.0)
+
+
+# ---------------------------------------------------------------------------
+# bf16 precision rung (einsum families)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("family,builder", [
+    ("linear", build_linear),
+    ("multiclass", build_multiclass),
+    ("fm", build_fm),
+    ("ffm", build_ffm),
+])
+def test_bf16_band_per_family(tmp_path, family, builder):
+    pred, names = builder(tmp_path)
+    rng = np.random.RandomState(9)
+    rows = request_rows(32, rng, names=names, extra_unknown=False)
+    s64 = CompiledScorer(pred, ladder=(32,))
+    s16 = CompiledScorer(pred, ladder=(32,), precision="bf16")
+    assert s16.rung_info()["precision"] == "bf16"
+    p64 = np.asarray(s64.predict_batch(rows), np.float64)
+    p16 = np.asarray(s16.predict_batch(rows), np.float64)
+    band = float(np.max(np.abs(p64 - p16)))
+    assert band < 0.1  # the serve_bench/check_bench_regress envelope
+    assert band > 0.0  # the rung genuinely relaxed the math
+    # scores stay finite and ordered enough to serve
+    assert np.all(np.isfinite(s16.score_batch(rows)))
+
+
+def test_bf16_ignored_for_gbdt(gbdt_case):
+    pred, _names, rows = gbdt_case
+    scorer = CompiledScorer(pred, ladder=(4,), precision="bf16")
+    # gbdt scoring keeps the f64 fold: still bit-identical
+    np.testing.assert_array_equal(
+        scorer.score_batch(rows), pred.batch_scores(rows)
+    )
+
+
+# ---------------------------------------------------------------------------
+# Sidecar plumbing: trainer dump, registry fingerprint, continual roots
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_trainer_dumps_bin_edges_sidecar(tmp_path):
+    """A real (tiny) training run dumps `<model>.bins.json`, and binned
+    serving picks it up in edges mode, matching the float path on the
+    training distribution."""
+    rng = np.random.RandomState(2)
+    train = tmp_path / "t.train"
+    with open(train, "w") as f:
+        for _ in range(300):
+            x = rng.randn(4)
+            y = int(x[0] + 0.5 * x[1] + 0.1 * rng.randn() > 0)
+            feats = ",".join(f"c{i}:{x[i]:.5f}" for i in range(4))
+            f.write(f"1###{y}###{feats}\n")
+    model_path = tmp_path / "t.model"
+    from ytklearn_tpu.continual import retrain
+
+    cfg = {
+        "data": {"train": {"data_path": str(train)},
+                 "test": {"data_path": str(train)},
+                 "max_feature_dim": 4},
+        "model": {"data_path": str(model_path)},
+        "loss": {"loss_function": "sigmoid"},
+        "optimization": {"round_num": 3, "max_depth": 3,
+                         "learning_rate": 0.3},
+    }
+    res = retrain("gbdt", cfg)
+    assert res.promoted
+    side = str(model_path) + ".bins.json"
+    assert os.path.exists(side)
+    payload = json.load(open(side))
+    assert payload["schema"] == "ytk-bin-edges"
+    assert set(payload["features"]) == {"c0", "c1", "c2", "c3"}
+
+    from ytklearn_tpu.predict import create_predictor
+
+    pred = create_predictor("gbdt", {
+        "model": {"data_path": str(model_path)},
+        "optimization": {"loss_function": "sigmoid", "round_num": 3},
+    })
+    scorer = CompiledScorer(pred, ladder=(8,), mode="binned")
+    assert scorer.rung_info()["bin_mode"] == "edges"
+    rows = [
+        {f"c{i}": float(v) for i, v in enumerate(rng.randn(4))}
+        for _ in range(32)
+    ]
+    # random f64 rows never land exactly on a split boundary: exact
+    np.testing.assert_array_equal(
+        scorer.score_batch(rows), pred.batch_scores(rows)
+    )
+
+
+def test_registry_fingerprint_covers_bins_sidecar(tmp_path):
+    pred, _edges = _edges_model(tmp_path)
+    from ytklearn_tpu.serve.registry import _sidecar_paths
+
+    assert pred.params.model.data_path + ".bins.json" in _sidecar_paths(pred)
+
+
+def test_continual_roots_carry_bins_sidecar():
+    from ytklearn_tpu.continual.driver import _roots
+
+    roots = _roots("/m/model")
+    assert roots[".bins.json"] == "/m/model.bins.json"
+
+
+# ---------------------------------------------------------------------------
+# Hot path: the fused score path is implicit-transfer-free (--ytk-sanitize)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def fused_staged(gbdt_case):
+    """Stage + warm OUTSIDE the sanitize guard (conftest discipline)."""
+    pred, names, rows = gbdt_case
+    scorer = CompiledScorer(pred, ladder=(16,), mode="fused",
+                            fused_interpret=True)
+    want = pred.batch_scores(rows[:16])
+    return scorer, rows[:16], want
+
+
+@pytest.mark.hotpath("serve")
+def test_fused_score_path_hotpath(fused_staged):
+    """Steady-state fused scoring under jax.transfer_guard('disallow'):
+    host<->device hops stay explicit through the rung exec path."""
+    scorer, rows, want = fused_staged
+    np.testing.assert_array_equal(scorer.score_batch(rows), want)

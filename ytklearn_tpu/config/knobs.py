@@ -217,6 +217,24 @@ _knob("YTK_SERVE_AIMD_INC", "int", 8,
 _knob("YTK_SERVE_AIMD_BACKOFF", "float", 0.5,
       "AIMD multiplicative backoff factor applied to the raw batch "
       "target on a p99-SLO violation (must be in (0, 1))")
+_knob("YTK_SERVE_FUSED", "bool", False,
+      "serve-side fused Pallas GBDT traversal kernel (bit-identical "
+      "math, heap node layout resident in VMEM); falls back to the "
+      "stacked XLA path with a `serve.downgrade.*` counter when Mosaic "
+      "cannot compile it — see [serving.md](serving.md)")
+_knob("YTK_SERVE_BINNED", "bool", False,
+      "binned GBDT scoring rung: bin request rows once per batch "
+      "(dumped `<model>.bins.json` training edges, else ensemble-derived "
+      "thresholds — the latter bit-identical) and traverse on "
+      "uint8/uint16 bin indices via the fastest backend (Pallas on TPU, "
+      "native C++ on CPU, XLA fallback)")
+_knob("YTK_SERVE_PRECISION", "str", "f64",
+      "serving precision rung for the convex/FM/FFM einsum scorers: "
+      "`bf16` = bf16 operands with f32 accumulation (quality band "
+      "measured in scripts/serve_bench.py); GBDT/GBST scoring ignores it")
+_knob("YTK_SERVE_KERNEL_THREADS", "int", 0,
+      "row-parallel threads for the native serve kernel "
+      "(0 = min(8, cores); batches under 64 rows stay single-threaded)")
 _knob("YTK_SERVE_AIMD_WINDOW", "int", 16,
       "batches per AIMD adjustment window: the controller judges the "
       "window's worst observed request latency against the SLO once per "

@@ -131,6 +131,10 @@ def _roots(data_path: str) -> Dict[str, str]:
         "": data_path,
         "_dict": data_path + "_dict",
         "_feature_transform_stat": data_path + "_feature_transform_stat",
+        # serve-side bin-edge sidecar (gbdt/binning.dump_bin_edges): a
+        # promoted candidate must carry its own edges, and a rollback must
+        # restore the incumbent's
+        ".bins.json": data_path + ".bins.json",
     }
 
 

@@ -355,6 +355,100 @@ def build_bins_global(
 
 
 # ---------------------------------------------------------------------------
+# Serve-side bin-edge export: the trainer dumps each feature's sorted
+# representative values next to the model (`<data_path>.bins.json`), so the
+# serving layer can bin request rows ONCE per batch with the exact same
+# nearest-representative rule the training matrix used (bin_matrix) and
+# traverse the ensemble on small integer bin indices instead of float
+# compares (serve/kernels.py, docs/serving.md "Precision rungs"). The
+# sidecar rides the continual shadow/promote/archive moves (driver._roots)
+# and the serving fingerprint (registry._sidecar_paths).
+# ---------------------------------------------------------------------------
+
+BIN_EDGES_SCHEMA = "ytk-bin-edges"
+
+
+def bin_edges_path(data_path: str) -> str:
+    return data_path + ".bins.json"
+
+
+def model_text_digest(text: str) -> str:
+    """sha256 of the dumped model text — pairs a bin-edges sidecar with
+    the EXACT ensemble it was trained with (splits are midpoints, not
+    edge members, so no per-value check can detect a mismatched grid)."""
+    import hashlib
+
+    return hashlib.sha256(text.encode("utf-8")).hexdigest()
+
+
+def dump_bin_edges(fs, path: str, names: Sequence[str], bins: FeatureBins,
+                   split_type: str = "mean",
+                   model_digest: Optional[str] = None) -> None:
+    """Atomically dump per-feature representative values, name-keyed (the
+    dumped trees are name-keyed too). Written BEFORE the model file so a
+    fingerprint-watch reload never pairs a new ensemble with stale edges;
+    `model_digest` (sha256 of the model text about to land) lets serving
+    verify the pairing even across a crash between the two writes."""
+    import json
+
+    payload = {
+        "schema": BIN_EDGES_SCHEMA,
+        "version": 1,
+        "split_type": split_type,
+        "features": {
+            str(names[f]): [
+                float(v) for v in bins.values[f, : int(bins.counts[f])]
+            ]
+            for f in range(len(bins.counts))
+        },
+    }
+    if model_digest is not None:
+        payload["model_digest"] = model_digest
+    with fs.atomic_open(path) as f:
+        json.dump(payload, f)
+
+
+def load_bin_edges(
+    fs, path: str, model_digest: Optional[str] = None
+) -> Optional[Dict[str, np.ndarray]]:
+    """{feature name: sorted (cnt,) f64 edges} or None when the sidecar is
+    missing/unreadable (serving then derives thresholds from the ensemble
+    itself — serve/kernels.build_bin_table). When the caller passes the
+    served model's text digest, a sidecar carrying a DIFFERENT digest is
+    rejected — the new-edges/old-model window a crash between the trainer's
+    two writes can leave behind would otherwise misroute interior rows."""
+    import json
+    import logging
+
+    if not fs.exists(path):
+        return None
+    try:
+        with fs.open(path) as f:
+            payload = json.load(f)
+        if payload.get("schema") != BIN_EDGES_SCHEMA:
+            raise ValueError(f"not a bin-edges sidecar: {path}")
+        want = payload.get("model_digest")
+        if model_digest is not None and want is not None \
+                and want != model_digest:
+            logging.getLogger(__name__).warning(
+                "bin-edges sidecar %s was dumped for a different model "
+                "(digest mismatch); serving falls back to ensemble-derived "
+                "thresholds", path,
+            )
+            return None
+        return {
+            str(name): np.asarray(vals, np.float64)
+            for name, vals in payload["features"].items()
+        }
+    except (OSError, ValueError, KeyError, TypeError) as e:
+        logging.getLogger(__name__).warning(
+            "bin-edges sidecar %s unreadable (%s: %s); serving falls back "
+            "to ensemble-derived thresholds", path, type(e).__name__, e,
+        )
+        return None
+
+
+# ---------------------------------------------------------------------------
 # Exclusive feature bundling (EFB, LightGBM §5): merge mutually-exclusive
 # sparse columns into one offset-binned column at binning time, shrinking
 # the bin matrix's feature axis before it ever reaches HBM.

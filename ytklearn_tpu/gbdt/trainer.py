@@ -488,6 +488,10 @@ class GBDTTrainer:
                 obs_inc("gbdt.efb.features_bundled", plan.n_bundled_features)
                 obs_gauge("gbdt.stat.efb_cols_saved", float(F - plan.n_cols))
         self._efb_plan = plan
+        # serve-side binned scoring reads these back from the dumped
+        # sidecar (`<data_path>.bins.json`); edges are per ORIGINAL
+        # feature, pre-EFB, like the dumped trees
+        self._bins_sidecar = (list(train.feature_names or []), bins)
         F_cols = plan.n_cols if plan is not None else F
         # mesh>1: the growth program runs under shard_map with each device
         # owning a contiguous feature slice of the histograms — pad the
@@ -1514,6 +1518,7 @@ class GBDTTrainer:
         self._missing_fill = train.missing_fill
         log.info("building bins (%d features)...", F)
         bins = build_bins_global(train.X, train.weight, p, train.feature_names)
+        self._bins_sidecar = (list(train.feature_names or []), bins)
         B = bins.max_bins
         bins_np = bin_matrix(train.X, bins)
         bins_train = self._put(bins_np)
@@ -1703,6 +1708,7 @@ class GBDTTrainer:
 
     _missing_fill: Optional[np.ndarray] = None
     _efb_plan = None  # BundlePlan when EFB merged columns this run
+    _bins_sidecar = None  # (feature names, FeatureBins) for the serve sidecar
     _replay_bins = None  # transient pre-bundle matrices for warm-start replay
     _guard = None  # PreemptionGuard while train() runs (resilience/preempt.py)
 
@@ -1762,11 +1768,30 @@ class GBDTTrainer:
         if jax.process_index() != 0:
             return  # rank0-only dump (reference: GBDTOptimizer.java:434-437)
         p = self.params
+        model_text = model.dumps(with_stats=True)
+        if self._bins_sidecar is not None:
+            # bin-edge sidecar for serve-side binned scoring — written
+            # BEFORE the model so a fingerprint-watch reload (triggered by
+            # the model file) always finds edges at least as fresh; the
+            # embedded digest of the model text about to land lets serving
+            # reject the new-edges/old-model pairing a crash between the
+            # two writes would leave behind
+            from .binning import (
+                bin_edges_path, dump_bin_edges, model_text_digest,
+            )
+
+            names, bins = self._bins_sidecar
+            if len(names) == len(bins.counts):
+                dump_bin_edges(
+                    self.fs, bin_edges_path(p.model.data_path), names, bins,
+                    split_type=p.split_type,
+                    model_digest=model_text_digest(model_text),
+                )
         # atomic write-then-replace: the serving registry hot-reloads this
         # file on a fingerprint watch, so a reader must never see a
         # half-written ensemble
         with self.fs.atomic_open(p.model.data_path) as f:
-            f.write(model.dumps(with_stats=True))
+            f.write(model_text)
         if p.model.feature_importance_path:
             # reference format: header + name\tsum_split_count\tsum_gain
             # (dataflow/GBDTDataFlow.dumpFeatureImportance:397-415)

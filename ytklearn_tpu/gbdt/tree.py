@@ -139,6 +139,59 @@ class Tree:
             "leaf": p(self.leaf_value, np.float32, 0.0),
         }
 
+    def heap_arrays(
+        self, depth: int, feat_ids: Optional[List[int]] = None
+    ) -> Dict[str, np.ndarray]:
+        """Kernel-layout (perfect-heap) export for the serve-side fused
+        traversal kernels (serve/kernels.py): node at heap slot p has its
+        children at 2p+1 / 2p+2, so a fixed-depth walk needs no child
+        pointers — `slot = 2*slot + 2 - go_left` — and the leaf value is
+        read from the last heap level only. Leaves above `depth` become
+        always-go-left pad chains (split=+inf, dleft=1) whose leftmost
+        last-level descendant carries the value; unreachable last-level
+        slots hold -0.0 so a padded accumulation is a bit-exact no-op.
+
+        depth     heap depth (>= self.max_depth(), >= 1)
+        feat_ids  resolved column id per node (serve vocab); defaults to
+                  self.feat (train-time resolved ids)
+
+        Returns {feat (H,) i32, split (H,) f64, dleft (H,) i32,
+        inner (H,) bool, leaf (LL,) f64} with H = 2^(depth+1)-1 and
+        LL = 2^depth."""
+        if depth < max(self.max_depth(), 1):
+            raise ValueError(
+                f"heap depth {depth} < tree depth {self.max_depth()}"
+            )
+        H = (1 << (depth + 1)) - 1
+        LL = 1 << depth
+        feat = np.zeros(H, np.int32)
+        split = np.full(H, np.inf, np.float64)
+        dleft = np.ones(H, np.int32)
+        inner = np.zeros(H, bool)
+        leaf = np.full(LL, -0.0, np.float64)
+        ids = feat_ids if feat_ids is not None else self.feat
+
+        stack = [(0, 0, 0)]  # (orig nid, heap pos, depth)
+        while stack:
+            nid, pos, d = stack.pop()
+            if self.is_leaf(nid):
+                # descend leftmost through the pad chain (already
+                # initialized to always-left) to the last level
+                for _ in range(depth - d):
+                    pos = 2 * pos + 1
+                leaf[pos - (LL - 1)] = float(self.leaf_value[nid])
+                continue
+            feat[pos] = int(ids[nid])
+            split[pos] = float(self.split[nid])
+            dleft[pos] = int(bool(self.default_left[nid]))
+            inner[pos] = True
+            stack.append((self.left[nid], 2 * pos + 1, d + 1))
+            stack.append((self.right[nid], 2 * pos + 2, d + 1))
+        return {
+            "feat": feat, "split": split, "dleft": dleft,
+            "inner": inner, "leaf": leaf,
+        }
+
     # -- text I/O ---------------------------------------------------------
 
     def dump(self, booster_id: int, with_stats: bool = True) -> str:

@@ -62,7 +62,13 @@ def _sidecar_paths(predictor) -> list:
     plus the continual driver's version sidecar so a re-promotion with
     identical weights still fingerprints as a change."""
     p = predictor.params
-    paths = [p.model.data_path, p.model.data_path + ".version.json"]
+    paths = [
+        p.model.data_path,
+        p.model.data_path + ".version.json",
+        # bin-edge sidecar for serve-side binned scoring: an edges-only
+        # change must re-lower the scorer too (gbdt/binning.py)
+        p.model.data_path + ".bins.json",
+    ]
     feature = getattr(p, "feature", None)
     if feature is not None and feature.transform.switch_on:
         paths.append(p.model.data_path + "_feature_transform_stat")

@@ -32,6 +32,7 @@ concept and not supported here.
 from __future__ import annotations
 
 import contextlib
+import logging
 import math
 import threading
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -40,8 +41,8 @@ import numpy as np
 
 from ..config import knobs
 from ..obs import health as obs_health
-from ..obs import inc as obs_inc, span as obs_span
-from ..predict.base import OnlinePredictor
+from ..obs import event as obs_event, inc as obs_inc, span as obs_span
+from ..predict.base import OnlinePredictor, numpy_activation
 from ..predict.continuous import (
     FFMPredictor,
     FMPredictor,
@@ -49,6 +50,8 @@ from ..predict.continuous import (
     MulticlassLinearPredictor,
 )
 from ..predict.trees import GBDTPredictor, GBSTPredictor
+
+log = logging.getLogger(__name__)
 
 DEFAULT_LADDER = (1, 8, 64, 512)
 
@@ -104,26 +107,71 @@ def parse_ladder(spec: Optional[str] = None) -> Tuple[int, ...]:
     return tuple(rungs)
 
 
+def resolve_mode() -> str:
+    """Requested GBDT scoring rung from the knobs: binned wins over fused
+    (it subsumes it — integer compares through the same fused layouts),
+    default is the bit-identity stacked path."""
+    if knobs.get_bool("YTK_SERVE_BINNED"):
+        return "binned"
+    if knobs.get_bool("YTK_SERVE_FUSED"):
+        return "fused"
+    return "stacked"
+
+
 class CompiledScorer:
     """Batch scorer for one loaded model; thread-safe after construction
-    (score paths touch only immutable arrays + jit caches)."""
+    (score paths touch only immutable arrays + jit caches).
+
+    GBDT execution rungs (docs/serving.md "Precision rungs"): the default
+    `stacked` path keeps the bit-identity contract; `mode="fused"` routes
+    through the Pallas heap-traversal kernel (serve/kernels.py) and
+    `mode="binned"` additionally scores from uint8/uint16 bin indices
+    (dumped training edges, else ensemble thresholds) on the fastest
+    available backend (Pallas on TPU, the native C++ kernel on CPU, an
+    XLA packed walk everywhere). Every fallback is a named
+    `serve.downgrade.*` counter + event — a Mosaic/toolchain failure
+    costs throughput, never the server. `precision="bf16"` relaxes the
+    convex/FM/FFM einsum accumulations to bf16 inputs with f32
+    accumulation (quality bands measured in scripts/serve_bench.py)."""
 
     def __init__(
         self,
         predictor: OnlinePredictor,
         ladder: Optional[Sequence[int]] = None,
         warmup: bool = True,
+        mode: Optional[str] = None,
+        precision: Optional[str] = None,
+        fused_interpret: bool = False,
     ):
         import jax
 
         self.predictor = predictor
         self.ladder = tuple(sorted(set(ladder))) if ladder else parse_ladder()
         self.n_outputs = predictor.n_outputs
+        self.requested_mode = mode if mode is not None else resolve_mode()
+        if self.requested_mode not in ("stacked", "fused", "binned"):
+            raise ValueError(f"unknown serve mode {self.requested_mode!r}")
+        self.precision = (
+            precision
+            if precision is not None
+            else (knobs.get_str("YTK_SERVE_PRECISION") or "f64")
+        )
+        if self.precision not in ("f64", "bf16"):
+            raise ValueError(f"unknown serve precision {self.precision!r}")
+        self.mode = "stacked"  # effective; rung lowering may upgrade it
+        self.backend = "stacked-xla"
+        self.bin_mode: Optional[str] = None
+        self.bin_dtype: Optional[str] = None
+        self._fused_interpret = fused_interpret
         self._fill = 0.0  # pad/absent-feature value; NaN for gbdt (missing)
         self._bias_col: Optional[int] = None
+        self._exec = None  # non-jit execution override (binned backends)
+        self._prep_is_identity = False  # gbdt: rows pass through untransformed
         self._lower()
         self.dim = len(self.vocab) + (1 if self._bias_col is not None else 0)
         self._jit = jax.jit(self._kernel)
+        if self._exec is None:
+            self._exec = self._exec_jit
         # post-warmup compiles are a bug (the ladder exists to prevent
         # them); the sentinel makes one fire health.retrace loudly
         obs_health.install_trace_counters()
@@ -141,24 +189,69 @@ class CompiledScorer:
         compiles this causes are credited so scorers already armed (hot
         reload warms the replacement while the old one still serves) don't
         count them as steady-state retraces."""
-        import jax
-        import jax.numpy as jnp
-
         with compile_credit():
             with obs_span("serve.warmup", rungs=len(self.ladder)):
                 for rung in self.ladder:
                     X = np.full((rung, self.dim), self._fill, np.float64)
-                    s, p = self._jit(jnp.asarray(X))
-                    jax.device_get((s, p))  # block: compile+execute now
+                    self._exec(X)  # blocks: compile+execute now
                     obs_inc("serve.scorer.warmup_rungs")
         self._sentinel.arm()
         self._warm = True
+
+    def rung_info(self) -> Dict[str, object]:
+        """The effective scoring rung — bench/metrics evidence."""
+        info = {
+            "requested": self.requested_mode,
+            "mode": self.mode,
+            "backend": self.backend,
+            "precision": self.precision,
+            "downgraded": self.mode != self.requested_mode,
+        }
+        if self.bin_mode is not None:
+            info["bin_mode"] = self.bin_mode
+            info["bin_dtype"] = self.bin_dtype
+        return info
 
     def featurize(self, rows: Sequence[Dict[str, float]]) -> np.ndarray:
         """Request dicts -> dense (B, dim) float64 via the predictor's own
         host pipeline (hash + transform replay; raw values for gbdt)."""
         X = np.full((len(rows), self.dim), self._fill, np.float64)
         vocab = self.vocab
+        if self._prep_is_identity:
+            # gbdt rows need no transform replay: drain every dict with
+            # C-speed extend/map instead of a per-item python loop (~2x
+            # on the serve hot path, scripts/serve_bench.py)
+            import itertools
+
+            keys: List[str] = []
+            vals: List[float] = []
+            lens: List[int] = []
+            ke, ve, la = keys.extend, vals.extend, lens.append
+            for fmap in rows:
+                ke(fmap.keys())
+                ve(fmap.values())
+                la(len(fmap))
+            if keys:
+                jj = np.fromiter(
+                    map(vocab.get, keys, itertools.repeat(-1)),
+                    np.int64, len(keys),
+                )
+                ii = np.repeat(np.arange(len(rows)), lens)
+                m = jj >= 0  # unknown features drop, as in the host walk
+                try:
+                    vv = np.asarray(vals, np.float64)
+                except (ValueError, TypeError):
+                    # a non-numeric value on an UNKNOWN (dropped) feature
+                    # must not fail the request — the slow path never
+                    # converted it; a known feature's bad value still
+                    # raises, exactly like the scatter below would
+                    vv = np.asarray(
+                        [float(v) if k else 0.0 for v, k in zip(vals, m)],
+                        np.float64,
+                    )
+                if m.any():
+                    X[ii[m], jj[m]] = vv[m]
+            return X
         ii: List[int] = []
         jj: List[int] = []
         vv: List[float] = []
@@ -196,13 +289,16 @@ class CompiledScorer:
                 return r
         return self.ladder[-1]
 
-    def _run(self, rows) -> Tuple[np.ndarray, np.ndarray]:
+    def _exec_jit(self, chunk: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
         # host<->device hops at the jit boundary are EXPLICIT (jnp.asarray
         # in, device_get out): the --ytk-sanitize transfer guard proves the
         # steady-state score path performs no hidden implicit transfer
         import jax
         import jax.numpy as jnp
 
+        return jax.device_get(self._jit(jnp.asarray(chunk)))
+
+    def _run(self, rows) -> Tuple[np.ndarray, np.ndarray]:
         X = self.featurize(rows)
         B = X.shape[0]
         max_rung = self.ladder[-1]
@@ -219,7 +315,7 @@ class CompiledScorer:
                     [chunk, np.full((pad, self.dim), self._fill, np.float64)]
                 )
             with obs_span("serve.score", rung=rung, rows=rung - pad):
-                s, p = jax.device_get(self._jit(jnp.asarray(chunk)))
+                s, p = self._exec(chunk)
             obs_inc("serve.scorer.batches")
             obs_inc("serve.scorer.rows", rung - pad)
             obs_inc("serve.scorer.pad_rows", pad)
@@ -245,6 +341,11 @@ class CompiledScorer:
 
     def _lower(self) -> None:
         pred = self.predictor
+        if not isinstance(pred, GBDTPredictor):
+            # fused/binned are GBDT traversal rungs; the einsum families
+            # take their own kernels (optionally at the bf16 rung), so a
+            # fleet-wide YTK_SERVE_BINNED=1 is not a "downgrade" here
+            self.requested_mode = "stacked"
         if isinstance(pred, LinearPredictor):
             self._lower_linear()
         elif isinstance(pred, MulticlassLinearPredictor):
@@ -291,9 +392,24 @@ class CompiledScorer:
             w[self._bias_col] = pred.model_map[bias_name][0]
         act = self._act()
 
-        def kernel(X):
-            s = X @ w
-            return s, act(s)
+        if self.precision == "bf16":
+            import jax.numpy as jnp
+
+            w16 = jnp.asarray(w, jnp.bfloat16)
+
+            def kernel(X):
+                # bf16 operands, f32 accumulation (the MXU contract);
+                # quality band measured in scripts/serve_bench.py
+                s = jnp.matmul(
+                    X.astype(jnp.bfloat16), w16,
+                    preferred_element_type=jnp.float32,
+                ).astype(X.dtype)
+                return s, act(s)
+        else:
+
+            def kernel(X):
+                s = X @ w
+                return s, act(s)
 
         self._kernel = kernel
 
@@ -312,10 +428,26 @@ class CompiledScorer:
             W[self._bias_col] = pred.model_map[bias_name]
         act = self._act()
 
-        def kernel(X):
-            s = X @ W
-            s = jnp.concatenate([s, jnp.zeros((X.shape[0], 1), s.dtype)], axis=-1)
-            return s, act(s)
+        if self.precision == "bf16":
+            W16 = jnp.asarray(W, jnp.bfloat16)
+
+            def kernel(X):
+                s = jnp.matmul(
+                    X.astype(jnp.bfloat16), W16,
+                    preferred_element_type=jnp.float32,
+                ).astype(X.dtype)
+                s = jnp.concatenate(
+                    [s, jnp.zeros((X.shape[0], 1), s.dtype)], axis=-1
+                )
+                return s, act(s)
+        else:
+
+            def kernel(X):
+                s = X @ W
+                s = jnp.concatenate(
+                    [s, jnp.zeros((X.shape[0], 1), s.dtype)], axis=-1
+                )
+                return s, act(s)
 
         self._kernel = kernel
 
@@ -342,11 +474,26 @@ class CompiledScorer:
             V[self._bias_col] = row[1 : 1 + k]
         act = self._act()
 
-        def kernel(X):
-            S = X @ V
-            S2 = (X * X) @ (V * V)
-            s = X @ w + 0.5 * jnp.sum(S * S - S2, axis=-1)
-            return s, act(s)
+        if self.precision == "bf16":
+            w16 = jnp.asarray(w, jnp.bfloat16)
+            V16 = jnp.asarray(V, jnp.bfloat16)
+            V216 = jnp.asarray(V * V, jnp.bfloat16)
+
+            def kernel(X):
+                X16 = X.astype(jnp.bfloat16)
+                f32 = jnp.float32
+                S = jnp.matmul(X16, V16, preferred_element_type=f32)
+                S2 = jnp.matmul(X16 * X16, V216, preferred_element_type=f32)
+                wx = jnp.matmul(X16, w16, preferred_element_type=f32)
+                s = (wx + 0.5 * jnp.sum(S * S - S2, axis=-1)).astype(X.dtype)
+                return s, act(s)
+        else:
+
+            def kernel(X):
+                S = X @ V
+                S2 = (X * X) @ (V * V)
+                s = X @ w + 0.5 * jnp.sum(S * S - S2, axis=-1)
+                return s, act(s)
 
         self._kernel = kernel
 
@@ -386,13 +533,35 @@ class CompiledScorer:
         sn = np.einsum("dk,dk->d", V[np.arange(D), field_idx], V[np.arange(D), field_idx])
         act = self._act()
 
-        def kernel(X):
-            wx = X @ w
-            T = jnp.einsum("da,dfk,bd->bafk", M, V, X)
-            Q = jnp.einsum("bafk,bfak->b", T, T)
-            diag = (X * X) @ sn
-            s = wx + 0.5 * (Q - diag)
-            return s, act(s)
+        if self.precision == "bf16":
+            w16 = jnp.asarray(w, jnp.bfloat16)
+            M16 = jnp.asarray(M, jnp.bfloat16)
+            V16 = jnp.asarray(V, jnp.bfloat16)
+            sn16 = jnp.asarray(sn, jnp.bfloat16)
+
+            def kernel(X):
+                X16 = X.astype(jnp.bfloat16)
+                f32 = jnp.float32
+                wx = jnp.matmul(X16, w16, preferred_element_type=f32)
+                T = jnp.einsum(
+                    "da,dfk,bd->bafk", M16, V16, X16,
+                    preferred_element_type=f32,
+                )
+                Q = jnp.einsum("bafk,bfak->b", T, T)
+                diag = jnp.matmul(
+                    X16 * X16, sn16, preferred_element_type=f32
+                )
+                s = (wx + 0.5 * (Q - diag)).astype(X.dtype)
+                return s, act(s)
+        else:
+
+            def kernel(X):
+                wx = X @ w
+                T = jnp.einsum("da,dfk,bd->bafk", M, V, X)
+                Q = jnp.einsum("bafk,bfak->b", T, T)
+                diag = (X * X) @ sn
+                s = wx + 0.5 * (Q - diag)
+                return s, act(s)
 
         self._kernel = kernel
 
@@ -417,6 +586,7 @@ class CompiledScorer:
             return fmap.items()
 
         self._prep = _prep
+        self._prep_is_identity = True
 
         N = max((t.n_nodes() for t in trees), default=1)
         feat = np.full((max(T, 1), N), -1, np.int32)
@@ -482,6 +652,208 @@ class CompiledScorer:
             return s, act(s)
 
         self._kernel = kernel
+
+        # -- rung lowering (fused / binned) -------------------------------
+        # the bit-identity stacked kernel above stays built either way:
+        # it is the downgrade target when a rung cannot lower
+        if self.requested_mode == "stacked":
+            return
+        if K != 1:
+            self._downgrade(
+                f"{self.requested_mode}_to_stacked",
+                "multiclass ensemble (K > 1)",
+            )
+            return
+        if self.requested_mode == "fused":
+            self._try_fused_gbdt(trees, is_rf, rounds, base, act)
+        else:
+            self._try_binned_gbdt(trees, is_rf, rounds, base, act)
+
+    def _downgrade(self, kind: str, reason: str) -> None:
+        """Named rung fallback: counter + flight-ring event + log — a
+        Mosaic/toolchain failure must be visible, never silent (the r6
+        gbdt.downgrade.* discipline)."""
+        obs_inc("serve.downgrade.total")
+        obs_inc(f"serve.downgrade.{kind}")
+        obs_event("serve.downgrade", kind=kind, reason=reason[:200])
+        log.warning("serve rung downgrade %s: %s", kind, reason)
+
+    def _try_fused_gbdt(self, trees, is_rf, rounds, base, act) -> None:
+        import jax.numpy as jnp
+
+        from . import kernels
+
+        heap, why = kernels.build_heap(trees, self.vocab)
+        if heap is None:
+            self._downgrade("fused_to_stacked", why)
+            return
+        feat_j = jnp.asarray(heap.feat)
+        split_j = jnp.asarray(heap.split)
+        dl_j = jnp.asarray(heap.dleft)
+        leaf_j = jnp.asarray(heap.leaf)
+        depth = heap.depth
+        interp = self._fused_interpret
+        # AOT probe: ONE eager run at the LARGEST rung — the row wave is
+        # VMEM-resident, so the widest shape is the binding compile; a
+        # Mosaic/VMEM failure (or a CPU backend, where the kernel cannot
+        # compile at all) downgrades here at load time, never mid-request
+        try:
+            with compile_credit():
+                dummy = jnp.asarray(
+                    np.full((len(self.vocab), self.ladder[-1]), math.nan)
+                )
+                kernels.fused_scores(
+                    dummy, feat_j, split_j, dl_j, leaf_j, depth,
+                    interpret=interp,
+                )
+        except Exception as e:  # noqa: BLE001 — any lowering failure downgrades
+            self._downgrade(
+                "fused_to_stacked", f"{type(e).__name__}: {e}"
+            )
+            return
+
+        def kernel(X):
+            s = kernels.fused_scores(
+                jnp.transpose(X), feat_j, split_j, dl_j, leaf_j, depth,
+                interpret=interp,
+            )
+            if is_rf:
+                s = s / rounds
+            s = s + base
+            return s, act(s)
+
+        self._kernel = kernel
+        self.mode = "fused"
+        self.backend = "fused-pallas-interpret" if interp else "fused-pallas"
+
+    def _try_binned_gbdt(self, trees, is_rf, rounds, base, act) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        from ..gbdt.binning import bin_edges_path, load_bin_edges
+        from . import kernels
+
+        heap, why = kernels.build_heap(trees, self.vocab)
+        if heap is None:
+            self._downgrade("binned_to_stacked", why)
+            return
+        edges = None
+        data_path = getattr(self.predictor.params.model, "data_path", None)
+        if data_path:
+            from ..gbdt.binning import model_text_digest
+
+            try:
+                with self.predictor.fs.open(data_path) as f:
+                    digest = model_text_digest(f.read())
+            except OSError:
+                digest = None  # sidecar range checks still apply below
+            edges = load_bin_edges(
+                self.predictor.fs, bin_edges_path(data_path),
+                model_digest=digest,
+            )
+        table, why = kernels.build_bin_table(trees, self.vocab, edges)
+        if table is None:
+            self._downgrade("binned_to_stacked", why)
+            return
+        packed = kernels.pack_heap_nodes(heap, table)
+        depth, sentinel = heap.depth, table.sentinel
+        interp = self._fused_interpret
+        on_tpu = jax.default_backend() == "tpu"
+        backend = None
+
+        def tail(s):
+            if is_rf:
+                s = s / rounds
+            s = s + base
+            return s, act(s)
+
+        if on_tpu or interp:
+            # Pallas binned front: same probe discipline as the fused rung
+            feat_j = jnp.asarray(heap.feat)
+            rank1_j = jnp.asarray(
+                (packed >> kernels.FEAT_BITS)
+                & ((1 << kernels.RANK_BITS) - 1)
+            )
+            dl_j = jnp.asarray(heap.dleft)
+            leaf_j = jnp.asarray(heap.leaf)
+            try:
+                with compile_credit():
+                    dummy = jnp.full(
+                        (len(self.vocab), self.ladder[-1]), sentinel,
+                        jnp.int32,
+                    )
+                    kernels.binned_scores_pallas(
+                        dummy, feat_j, rank1_j, dl_j, leaf_j, depth,
+                        sentinel, interpret=interp,
+                    )
+
+                def binned_kernel(bw):
+                    s = kernels.binned_scores_pallas(
+                        jnp.transpose(bw), feat_j, rank1_j, dl_j, leaf_j,
+                        depth, sentinel, interpret=interp,
+                    )
+                    return tail(s)
+
+                backend = (
+                    "binned-pallas-interpret" if interp else "binned-pallas"
+                )
+            except Exception as e:  # noqa: BLE001 — fall through the binned chain
+                # still the binned rung, but on the slower XLA walk — a
+                # Mosaic regression must trip dashboards like every other
+                # rung fallback, not hide as a quiet throughput drop
+                self._downgrade(
+                    "binned_pallas_to_xla", f"{type(e).__name__}: {e}"
+                )
+        np_act = numpy_activation(self.predictor.loss)
+        if backend is None and not on_tpu:
+            native_ok = (
+                np_act is not None and kernels.native_serve_available()
+            )
+            if not native_ok and not knobs.get_bool("YTK_NO_NATIVE"):
+                self._downgrade(
+                    "binned_native_to_xla",
+                    "native serve kernel unavailable (toolchain?)"
+                    if np_act is not None
+                    else "no numpy activation for this loss",
+                )
+        else:
+            native_ok = False
+        if backend is None and native_ok:
+            threads = kernels.resolve_kernel_threads()
+            heap_leaf = np.ascontiguousarray(heap.leaf)
+
+            def exec_native(chunk):
+                bins = kernels.bin_rows(chunk, table)
+                s = kernels.native_binned_scores(
+                    bins, packed, heap_leaf, depth, sentinel, threads,
+                )
+                if is_rf:
+                    s = s / rounds
+                s = s + base
+                return s, np_act(s)
+
+            self._exec = exec_native
+            backend = "binned-native"
+        if backend is None:
+            run = kernels.make_binned_xla(packed, heap.leaf, depth, sentinel)
+
+            def binned_kernel(bw):  # noqa: F811 — the chain picks exactly one
+                return tail(run(bw))
+
+            backend = "binned-xla"
+        if backend != "binned-native":
+            binned_jit = jax.jit(binned_kernel)
+
+            def exec_binned(chunk):
+                bins = kernels.bin_rows(chunk, table).astype(np.int32)
+                return jax.device_get(binned_jit(jnp.asarray(bins)))
+
+            self._exec = exec_binned
+        self.mode = "binned"
+        self.backend = backend
+        self.bin_mode = table.mode
+        self.bin_dtype = str(np.dtype(table.dtype))
+        self._bin_table = table  # introspection / tests
 
     def _lower_gbst(self) -> None:
         import jax.numpy as jnp

@@ -251,6 +251,9 @@ class ServeApp:
                     "version": self.registry.get(n).version,
                     "ladder": list(self.registry.get(n).scorer.ladder),
                     "pinned": self.registry.pinned(n),
+                    # effective scoring rung + backend (fused/binned
+                    # lowering evidence — serve_bench fleet records it)
+                    "rung": self.registry.get(n).scorer.rung_info(),
                 }
                 for n in self.registry.names()
             },
