@@ -31,6 +31,11 @@ bands are re-checked absolutely — binned request-stream band under
 SERVE_BINNED_BAND, every bf16 family band under SERVE_BF16_BAND — so a
 relaxed-precision rung can never quietly ship outside its envelope.
 
+Tracing-overhead gate: the newest serve_rungs artifact's recorded
+tracing_overhead line is re-checked absolutely — 1%-head-sampled request
+tracing must stay within the throughput band of tracing-off. Artifacts
+predating the field skip cleanly.
+
 Fleet gate: schema "serve_fleet" artifacts (schema_version 2,
 `serve_bench.py --fleet`) are a different workload — N replica processes
 — so they are compared ONLY against predecessors with the same metric
@@ -362,6 +367,47 @@ def check_rung_quality(artifacts: List[Tuple[int, str]]) -> List[str]:
     return []
 
 
+def check_tracing_overhead(
+    artifacts: List[Tuple[int, str]], tol: float
+) -> List[str]:
+    """Absolute gate on the NEWEST serve_rungs artifact's recorded
+    tracing-overhead line: 1%-sampled request tracing must stay within
+    the regress band of tracing-off. Artifacts predating the field (and
+    non-rung schemas) skip cleanly."""
+    import json
+
+    for rnd, path in reversed(artifacts):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if "parsed" in rec and "cmd" in rec:
+            rec = rec["parsed"] or {}
+        if rec.get("schema") != "serve_rungs":
+            continue
+        t = rec.get("tracing_overhead") or {}
+        off = t.get("off_req_per_sec")
+        sampled = t.get("sampled_req_per_sec")
+        if not off or sampled is None:
+            print(f"  tracing overhead: r{rnd} predates the field (skip)")
+            return []
+        floor = off * (1.0 - tol)
+        print(
+            f"  tracing overhead (r{rnd}): sampled {sampled:.1f} vs off "
+            f"{off:.1f} req/s (floor {floor:.1f}, tol {tol:.0%})"
+        )
+        if sampled < floor:
+            return [
+                f"sampled tracing overhead out of band: {sampled:.1f} < "
+                f"{off:.1f} * (1 - {tol}) req/s in "
+                f"{os.path.basename(path)}"
+            ]
+        return []
+    print("  tracing overhead: no serve_rungs artifact (skip)")
+    return []
+
+
 def check_fleet(old, new, tol: float) -> List[str]:
     """-> failure messages for the fleet pair (same replica count)."""
     (o_rnd, _o_path, o), (n_rnd, _n_path, n) = old, new
@@ -547,6 +593,7 @@ def main(argv=None) -> int:
         for pair in serve_pairs:
             fails += check_serve(*pair, tol=args.tol)
     fails += check_rung_quality(serve_artifacts)
+    fails += check_tracing_overhead(serve_artifacts, tol=args.tol)
 
     fleet_pair = fleet_comparable_pair(serve_artifacts)
     if fleet_pair is None:

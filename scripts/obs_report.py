@@ -10,6 +10,9 @@ telemetry, and the collective census.
     python scripts/obs_report.py /tmp/events.jsonl      # YTK_TRACE_JSONL
     python scripts/obs_report.py BENCH_r05.json         # bench artifact
     python scripts/obs_report.py lint.json              # ytklint --format json
+    python scripts/obs_report.py traces.json            # /admin/traces snapshot
+    python scripts/obs_report.py traces.json --perfetto merged.json
+    python scripts/obs_report.py metrics.json           # /metrics?history=1
 
 Input kind is sniffed, not flagged:
   flight dump   JSON object with a "flight" block (obs/recorder.py)
@@ -19,6 +22,15 @@ Input kind is sniffed, not flagged:
                 wrapper's "parsed")
   fleet metrics a FleetFront /metrics snapshot ("fleet" + "replicas"
                 keys) — rendered as a per-replica fleet table
+  serve metrics a replica/solo /metrics snapshot — history sparklines
+                when saved with ?history=1
+  trace rings   an /admin/traces snapshot (schema "ytk_traces", solo or
+                fleet-aggregated) — rendered as a per-stage latency
+                WATERFALL naming where the p99 lives, plus the p99
+                exemplar's hop decomposition; `--perfetto OUT.json`
+                additionally writes every ring merged into one
+                clock-aligned Chrome trace (each process's wall_t0
+                anchors its hop offsets — the spawn-banner handshake)
   lint report   `ytklint --format json` / `check_lint.sh --json` output
                 (schema "ytklint") — findings per rule plus the live
                 reasoned-suppression inventory, so CI annotations and
@@ -27,7 +39,8 @@ Input kind is sniffed, not flagged:
 Fleet postmortems: any artifact whose counters/events carry
 serve.worker.* / serve.front.* evidence gets a "serving fleet" section,
 and events stamped with a replica identity (obs.set_identity) name the
-replica inline.
+replica inline. Flight dumps from traced serving processes carry their
+exemplar ring (`flight.traces`) and get the waterfall section too.
 """
 
 from __future__ import annotations
@@ -65,6 +78,24 @@ def _load(path: str) -> Tuple[str, dict]:
         # single-line artifacts (everything json.dump writes) already
         # parsed fully via the first line — don't parse the bytes twice
         doc = head if isinstance(head, dict) else json.load(f)
+    if doc.get("schema") == "ytk_traces":
+        return "traces", {
+            "events": [],
+            "counters": {},
+            "gauges": {},
+            "flight": None,
+            "bench": None,
+            "traces": doc,
+        }
+    if doc.get("schema") == "trace_drill":
+        return "trace-drill", {
+            "events": [],
+            "counters": {},
+            "gauges": {},
+            "flight": None,
+            "bench": None,
+            "drill": doc,
+        }
     if "flight" in doc:
         fl = doc["flight"]
         snap = fl.get("snapshot") or {}
@@ -117,6 +148,18 @@ def _load(path: str) -> Tuple[str, dict]:
             "flight": None,
             "bench": None,
             "fleet_metrics": doc,
+            "history": doc.get("history"),
+        }
+    if "latency" in doc and "counters" in doc and "metric" not in doc:
+        # a replica/solo ServeApp /metrics snapshot (?history=1 carries
+        # the per-metric time-series rings)
+        return "serve-metrics", {
+            "events": [],
+            "counters": doc.get("counters") or {},
+            "gauges": doc.get("gauges") or {},
+            "flight": None,
+            "bench": None,
+            "history": doc.get("history"),
         }
     rec = doc.get("parsed") if ("parsed" in doc and "cmd" in doc) else doc
     rec = rec or {}
@@ -164,10 +207,209 @@ def _prefixed(d: Dict[str, float], prefix: str) -> Dict[str, float]:
     return {k: v for k, v in d.items() if k.startswith(prefix)}
 
 
-def report(path: str) -> None:
+# ---------------------------------------------------------------------------
+# Request-trace waterfall (/admin/traces snapshots, flight.traces rings)
+# ---------------------------------------------------------------------------
+
+
+def _pct(vals: List[float], q: float) -> float:
+    if not vals:
+        return 0.0
+    s = sorted(vals)
+    return s[min(len(s) - 1, max(0, int(round(q / 100.0 * (len(s) - 1)))))]
+
+
+def _trace_payloads(doc: dict) -> List[dict]:
+    """Flatten a ytk_traces document (solo or fleet-aggregated) into the
+    per-process payloads; index 0 is the client-facing process (front or
+    solo server)."""
+    if "exemplars" in doc:
+        return [doc]
+    out = []
+    if isinstance(doc.get("front"), dict):
+        out.append(doc["front"])
+    for _rid, p in sorted((doc.get("replicas") or {}).items()):
+        if isinstance(p, dict) and "exemplars" in p:
+            out.append(p)
+    return out
+
+
+def render_traces(doc: dict) -> None:
+    """Per-stage latency waterfall over every exemplar hop, naming where
+    the p99 lives, plus the p99 exemplar's own hop decomposition (front
+    and replica sides aligned via each process's wall_t0)."""
+    payloads = _trace_payloads(doc)
+    n_ex = sum(len(p.get("exemplars") or []) for p in payloads)
+    _section("request-trace waterfall (exemplar rings)")
+    if not n_ex:
+        print("  no exemplars recorded (sampling off or no traffic)")
+        return
+    kept: Dict[str, int] = defaultdict(int)
+    per_stage: Dict[str, List[float]] = defaultdict(list)
+    for p in payloads:
+        for rec in p.get("exemplars") or []:
+            kept[str(rec.get("kept", "?"))] += 1
+            for hop in rec.get("hops") or []:
+                per_stage[hop["name"]].append(float(hop.get("dur_ms", 0.0)))
+    print(f"  processes: {len(payloads)}  exemplars: {n_ex}  kept: "
+          + " ".join(f"{k}={v}" for k, v in sorted(kept.items())))
+    front = payloads[0]
+    client = [r for r in front.get("exemplars") or []
+              if r.get("latency_ms") is not None]
+    lats = [float(r["latency_ms"]) for r in client]
+    if lats:
+        print(f"  client-visible exemplar latency: p50={_pct(lats, 50):.3f} "
+              f"p99={_pct(lats, 99):.3f} max={max(lats):.3f} ms "
+              f"(n={len(lats)})")
+    if per_stage:
+        print(f"  {'stage':<22s} {'count':>6s} {'mean ms':>9s} "
+              f"{'p50 ms':>9s} {'p99 ms':>9s} {'total ms':>10s}")
+        rows = sorted(per_stage.items(), key=lambda kv: -_pct(kv[1], 99))
+        for name, durs in rows:
+            print(f"  {name:<22s} {len(durs):>6d} "
+                  f"{sum(durs) / len(durs):>9.3f} {_pct(durs, 50):>9.3f} "
+                  f"{_pct(durs, 99):>9.3f} {sum(durs):>10.2f}")
+        print(f"  p99 lives in: {rows[0][0]} "
+              f"(stage p99 {_pct(rows[0][1], 99):.3f} ms)")
+    if not client:
+        return
+    # the p99 exemplar, decomposed — front-side hops plus any replica
+    # record carrying the same trace id, clock-aligned via wall_t0
+    target = sorted(client, key=lambda r: float(r["latency_ms"]))[
+        min(len(client) - 1, int(round(0.99 * (len(client) - 1))))
+    ]
+    tid = target.get("trace_id")
+    t_wall0 = (front.get("wall_t0") or 0.0) + float(target.get("ts", 0.0))
+    print(f"\n  p99 exemplar {tid} kept={target.get('kept')} "
+          f"status={target.get('status')} "
+          f"latency={target.get('latency_ms')} ms rows={target.get('rows')}")
+    hop_sum = 0.0
+    for hop in sorted(target.get("hops") or [], key=lambda h: h.get("ts", 0)):
+        off = (front.get("wall_t0") or 0.0) + hop.get("ts", 0.0) - t_wall0
+        hop_sum += float(hop.get("dur_ms", 0.0))
+        print(f"    +{off * 1e3:8.3f} ms {hop['name']:<20s} "
+              f"{hop.get('dur_ms', 0.0):9.3f} ms  {hop.get('args', '')}")
+    for p in payloads[1:]:
+        for rec in p.get("exemplars") or []:
+            ids = [rec.get("trace_id")] + list(rec.get("trace_ids") or [])
+            if tid not in ids:
+                continue
+            who = (rec.get("replica_id") if "replica_id" in rec
+                   else (p.get("identity") or {}).get("replica_id"))
+            print(f"    └ replica {who} (pid {p.get('pid')}):")
+            for hop in sorted(rec.get("hops") or [],
+                              key=lambda h: h.get("ts", 0)):
+                off = ((p.get("wall_t0") or 0.0) + hop.get("ts", 0.0)
+                       - t_wall0)
+                print(f"      +{off * 1e3:8.3f} ms {hop['name']:<18s} "
+                      f"{hop.get('dur_ms', 0.0):9.3f} ms  "
+                      f"{hop.get('args', '')}")
+    if target.get("latency_ms"):
+        share = 100.0 * hop_sum / float(target["latency_ms"])
+        print(f"  front-side hop sum {hop_sum:.3f} ms = {share:.1f}% of the "
+              "client-visible latency")
+
+
+def write_perfetto(doc: dict, out_path: str) -> str:
+    """Merge every ring of a ytk_traces document into one clock-aligned
+    Chrome-trace/Perfetto JSON (obs.export.exemplar_trace_events)."""
+    from ytklearn_tpu.obs import exemplar_trace_events
+
+    events = exemplar_trace_events(_trace_payloads(doc))
+    with open(out_path, "w") as f:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms",
+                   "otherData": {"producer": "obs_report traces merge"}}, f)
+    print(f"  merged Perfetto trace written to {out_path} "
+          f"({len(events)} events)")
+    return out_path
+
+
+# ---------------------------------------------------------------------------
+# Metrics-history sparklines (/metrics?history=1 snapshots)
+# ---------------------------------------------------------------------------
+
+_SPARK = "▁▂▃▄▅▆▇█"
+
+
+def _sparkline(vals: List[float]) -> str:
+    if not vals:
+        return ""
+    lo, hi = min(vals), max(vals)
+    if hi - lo < 1e-12:
+        return _SPARK[0] * min(len(vals), 60)
+    return "".join(
+        _SPARK[int((v - lo) / (hi - lo) * (len(_SPARK) - 1))]
+        for v in vals[-60:]
+    )
+
+
+def render_history(hist: Optional[dict]) -> None:
+    series = (hist or {}).get("series") or {}
+    if not series:
+        return
+    _section("metrics history (sparklines, oldest -> newest)")
+    shown = 0
+    for name, pts in sorted(series.items()):
+        vals = [float(v) for _, v in pts]
+        if len(vals) < 2:
+            continue
+        if max(vals) == min(vals) and not name.startswith("health."):
+            continue  # flat non-health series are noise in a postmortem
+        deltas = [b - a for a, b in zip(vals, vals[1:])]
+        if len(vals) >= 3 and all(d >= 0 for d in deltas) and any(deltas):
+            # monotone counter: the per-sample delta IS the rate shape
+            line, tag = _sparkline(deltas), "Δ"
+        else:
+            line, tag = _sparkline(vals), " "
+        print(f"  {name:<40s} {tag} {line} last={vals[-1]:g}")
+        shown += 1
+        if shown >= 40:
+            print("  ... (more series elided)")
+            break
+
+
+def report(path: str, perfetto: Optional[str] = None) -> None:
     kind, data = _load(path)
     counters, gauges, events = data["counters"], data["gauges"], data["events"]
     print(f"== run-health report: {os.path.basename(path)} ({kind}) ==")
+
+    tr = data.get("traces")
+    if tr:
+        render_traces(tr)
+        if perfetto:
+            write_perfetto(tr, perfetto)
+        return  # a trace snapshot carries no other runtime sections
+
+    drill = data.get("drill")
+    if drill:
+        _section("trace drill (scripts/trace_drill.py)")
+        print(f"  ok: {drill.get('ok')}  model: {drill.get('data_source')} "
+              f"x{drill.get('trees')} trees, {drill.get('replicas')} "
+              "replicas")
+        s1 = (drill.get("steps") or {}).get("traced_fleet") or {}
+        if s1:
+            print(f"  traced fleet: {s1.get('requests')} requests, "
+                  f"p99 {s1.get('p99_exemplar_ms')} ms, hop sum "
+                  f"{s1.get('p99_hop_sum_ms')} ms "
+                  f"({100 * (s1.get('p99_hop_share') or 0):.1f}%)")
+        s2 = (drill.get("steps") or {}).get("overhead") or {}
+        if s2:
+            print(f"  tracing overhead: off {s2.get('off_req_per_sec')} / "
+                  f"sampled {s2.get('sampled_req_per_sec')} / always "
+                  f"{s2.get('always_req_per_sec')} req/s")
+        s3 = (drill.get("steps") or {}).get("slo_burn") or {}
+        if s3:
+            print(f"  slo burn: fired {s3.get('slo_burn_fired'):g}x, "
+                  f"in dump: {s3.get('event_in_dump')}, tail exemplars: "
+                  f"{s3.get('tail_exemplars_in_dump')}")
+        for msg in drill.get("failures") or []:
+            print(f"  FAIL: {msg}")
+        if perfetto:
+            print("note: --perfetto ignored — a trace_drill artifact is "
+                  "a summary; merge the drill's saved "
+                  "trace_drill_traces.json snapshot instead",
+                  file=sys.stderr)
+        return
 
     fl = data["flight"]
     if fl:
@@ -373,6 +615,28 @@ def report(path: str) -> None:
             )
             print(f"  event {e['name']} @ {e.get('ts', 0):.3f}s {detail}")
 
+    if fl and fl.get("traces"):
+        # a traced serving process's flight dump carries its exemplar
+        # ring: render the same waterfall a live /admin/traces would get
+        flight_rings = {
+            "exemplars": fl["traces"],
+            "wall_t0": fl.get("wall_t0"),
+            "pid": (fl.get("runtime") or {}).get("pid"),
+            "identity": (fl.get("runtime") or {}).get("identity") or {},
+        }
+        render_traces(flight_rings)
+        if perfetto:
+            write_perfetto(flight_rings, perfetto)
+            perfetto = None  # consumed
+    if perfetto:
+        # every other artifact kind carries no exemplar rings to merge —
+        # say so instead of leaving the operator with a missing file
+        print("note: --perfetto ignored — this artifact carries no "
+              "exemplar rings (use an /admin/traces snapshot or a "
+              "traced flight dump)", file=sys.stderr)
+
+    render_history(data.get("history"))
+
     mem = _prefixed(gauges, "mem.")
     if mem:
         _section("memory watermarks")
@@ -415,12 +679,26 @@ def report(path: str) -> None:
 
 
 def main(argv: Optional[List[str]] = None) -> int:
-    argv = sys.argv[1:] if argv is None else argv
+    argv = list(sys.argv[1:] if argv is None else argv)
+    perfetto = None
+    if "--perfetto" in argv:
+        i = argv.index("--perfetto")
+        if i + 1 >= len(argv):
+            print("--perfetto needs an output path", file=sys.stderr)
+            return 2
+        perfetto = argv[i + 1]
+        del argv[i:i + 2]
     if not argv or argv[0] in ("-h", "--help"):
         print(__doc__)
         return 0 if argv else 2
+    if perfetto and len(argv) > 1:
+        # each input would overwrite the same merged output silently; a
+        # fleet-aggregated /admin/traces snapshot is already ONE file
+        print("--perfetto takes exactly one input artifact",
+              file=sys.stderr)
+        return 2
     for path in argv:
-        report(path)
+        report(path, perfetto=perfetto)
     return 0
 
 

@@ -19,8 +19,13 @@ and reports per-rung sustained req/s + latency p50/p99 (queue wait
 included), the bit-identity check against `batch_scores`, the post-warmup
 retrace count across a mixed-request-size sweep (must be 0), the binned
 rung's quality band (max |prediction diff| on the request stream + the
-fraction of deliberately boundary-valued rows that diverge), and the
-bf16 precision-rung band per einsum family (linear/FM/FFM).
+fraction of deliberately boundary-valued rows that diverge), the bf16
+precision-rung band per einsum family (linear/FM/FFM), and the
+TRACING-OVERHEAD line: the default rung driven through the full
+ServeApp.predict path with request tracing off / head-sampled at 1% /
+always-on (`tracing_overhead` field; sampled must stay within the
+BENCH_REGRESS_TOL band of off — check_bench_regress re-gates the
+recorded artifact and skips artifacts predating the field).
 
 Model: the agaricus GBDT demo (trained on the spot) when /root/reference
 is present, else a synthetic ensemble in the same format. Emits one
@@ -425,6 +430,89 @@ def _build_ffm_model(tmp_dir, rng, n_fields=4, per_field=4, k=4):
     cfg = {"model": {"data_path": path, "field_dict_path": fd},
            "loss": {"loss_function": "sigmoid"}, "k": [1, k]}
     return create_predictor("ffm", cfg), names
+
+
+# ---------------------------------------------------------------------------
+# Tracing overhead (off / sampled / always-on through the ServeApp path)
+# ---------------------------------------------------------------------------
+
+
+def _drive_app_threads(app, rows, seconds, threads=16):
+    """Synchronous app.predict() from N client threads -> completed
+    req/s. The SAME harness for every tracing arm, so the ratio isolates
+    the tracing plane's cost (begin/finish + hop recording), not driver
+    noise."""
+    import threading as _threading
+
+    stop = [False]
+    counts = [0] * threads
+
+    def worker(k):
+        i = k
+        while not stop[0]:
+            try:
+                app.predict([rows[i % len(rows)]], timeout=30.0)
+                counts[k] += 1
+            # ytklint: allow(broad-except-swallow) reason=an overload shed or timeout mid-arm is expected under the driving load; only completed requests count
+            except Exception:
+                pass
+            i += threads
+
+    ts = [_threading.Thread(target=worker, args=(k,), daemon=True)
+          for k in range(threads)]
+    t0 = time.perf_counter()
+    for t in ts:
+        t.start()
+    time.sleep(seconds)
+    stop[0] = True
+    for t in ts:
+        t.join(timeout=30.0)
+    return sum(counts) / (time.perf_counter() - t0)
+
+
+def measure_tracing_overhead(tmp_dir, trees, rows, seconds, log) -> dict:
+    """The tracing-overhead line (ISSUE 13): the default rung driven
+    through the full ServeApp.predict path with the trace plane off,
+    head-sampled at 1%, and always-on. Gated (main) so the sampled rate —
+    the production default — stays within the existing regress band of
+    tracing-off."""
+    from ytklearn_tpu.config import knobs as _knobs
+    from ytklearn_tpu.obs import trace as obs_trace
+    from ytklearn_tpu.serve import BatchPolicy, ModelRegistry, ServeApp
+    from ytklearn_tpu.serve.scorer import compile_credit
+
+    cfg = {"model": {"data_path": os.path.join(tmp_dir, "gbdt.model")},
+           "optimization": {"loss_function": "sigmoid", "round_num": trees}}
+    reg = ModelRegistry(watch_interval_s=0)
+    with compile_credit():
+        reg.load("default", "gbdt", cfg)
+    app = ServeApp(reg, BatchPolicy(max_batch=512, max_wait_ms=1.0,
+                                    max_queue=1 << 15))
+    out = {"sample_rate": 0.01, "threads": 16}
+    try:
+        _drive_app_threads(app, rows, min(seconds, 1.0))  # warm the path
+        for label, rate in (("off", 0.0), ("sampled", 0.01),
+                            ("always", 1.0)):
+            obs_trace.configure_tracing(sample=rate, reset=True)
+            qps = _drive_app_threads(app, rows, seconds)
+            out[f"{label}_req_per_sec"] = round(qps, 1)
+            if label != "off":
+                out[f"{label}_exemplars"] = len(obs_trace.exemplars())
+            log.info("tracing overhead arm %-8s %8.0f req/s", label, qps)
+    finally:
+        # restore the env-configured plane for whatever runs next
+        obs_trace.configure_tracing(
+            sample=_knobs.get_float("YTK_TRACE_SAMPLE") or 0.0, reset=True
+        )
+        for b in app._batchers.values():
+            b.close(drain=True)
+        reg.close()
+    off = out.get("off_req_per_sec") or 0.0
+    if off > 0:
+        out["sampled_over_off"] = round(out["sampled_req_per_sec"] / off, 4)
+        out["always_over_off"] = round(out["always_req_per_sec"] / off, 4)
+    log.info("tracing overhead: %s", out)
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -944,6 +1032,10 @@ def main() -> int:
 
         bands = measure_bf16_bands(tmp_dir, log)
 
+        tracing = measure_tracing_overhead(
+            tmp_dir, len(pred.model.trees), rows, args.seconds, log
+        )
+
         best = max(
             (r for r in rungs if r["rung"] != "default"),
             key=lambda r: r["req_per_sec"],
@@ -980,6 +1072,7 @@ def main() -> int:
             "best_rung_speedup": best["speedup_vs_default"],
             "binned_quality": quality,
             "precision_bands": bands,
+            "tracing_overhead": tracing,
             "data_source": source,
             "trees": len(pred.model.trees),
             "obs": {
@@ -1035,6 +1128,17 @@ def main() -> int:
                     f"bf16 band {band:.3g} > {bf16_band:.3g} for {family} "
                     "(env SERVE_BF16_BAND)"
                 )
+        # sampled tracing (the production default) must cost less than
+        # the existing throughput regress band vs tracing-off
+        trace_tol = float(os.environ.get("BENCH_REGRESS_TOL", "0.15"))
+        t_off = tracing.get("off_req_per_sec") or 0.0
+        t_sam = tracing.get("sampled_req_per_sec") or 0.0
+        if t_off > 0 and t_sam < t_off * (1.0 - trace_tol):
+            fails.append(
+                f"sampled tracing overhead: {t_sam:.0f} req/s < "
+                f"{t_off:.0f} * (1 - {trace_tol}) with 1% head sampling "
+                "(env BENCH_REGRESS_TOL)"
+            )
         if fleet_rec is not None and fleet_rec.get("retraces_fleet"):
             fails.append(
                 f"rungs-fleet run retraced "

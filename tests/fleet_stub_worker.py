@@ -21,6 +21,10 @@ import time
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+#: the banner clock handshake the real worker reports (obs.core.WALL_T0);
+#: the stub has no obs import, so its "clock origin" is process start
+WALL_T0 = time.time()
+
 
 def main() -> int:
     ap = argparse.ArgumentParser()
@@ -72,6 +76,16 @@ def main() -> int:
                                  "health.retrace": 0},
                     "gauges": {},
                 })
+            elif path == "/admin/traces":
+                # minimal ytk_traces document: the stub records no hops,
+                # but the front's fleet aggregation must see the contract
+                self._json(200, {
+                    "schema": "ytk_traces", "schema_version": 1,
+                    "pid": os.getpid(), "wall_t0": WALL_T0,
+                    "sample": 0.0, "slo_ms": None,
+                    "identity": {"replica_id": args.replica_id},
+                    "exemplars": [],
+                })
             elif path == "/healthz":
                 self._json(200, {"status": "ok"})
             else:
@@ -100,7 +114,12 @@ def main() -> int:
             scores = [args.weight * sum(r.values()) for r in rows]
             with lock:
                 state["requests"] += 1
-                state["latencies"].append(round(args.delay_ms + 1.0, 3))
+                # (wall_ts, ms) pairs: the front WINDOWS the ring union,
+                # so samples must carry their timestamps (server.py
+                # _LatencyWindow contract)
+                state["latencies"].append(
+                    [round(time.time(), 3), round(args.delay_ms + 1.0, 3)]
+                )
             self._json(200, {
                 "model": "default",
                 "version": args.version,
@@ -112,7 +131,8 @@ def main() -> int:
     httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
     print(json.dumps({"port": httpd.server_address[1],
                       "pid": os.getpid(),
-                      "replica_id": args.replica_id}), flush=True)
+                      "replica_id": args.replica_id,
+                      "wall_t0": WALL_T0}), flush=True)
     try:
         httpd.serve_forever(poll_interval=0.1)
     except KeyboardInterrupt:

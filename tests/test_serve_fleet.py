@@ -502,7 +502,12 @@ def test_metrics_raw_ring_export(tmp_path):
             app.predict([{"c0": float(i)}], timeout=10.0)
         assert "raw_ms" not in app.metrics_payload()["latency"]
         raw = app.metrics_payload(raw=True)["latency"]["raw_ms"]
-        assert len(raw) == 3 and all(v >= 0 for v in raw)
+        # (wall_ts, ms) pairs since r17: the front windows the union on
+        # the timestamps, so stale idle-replica samples stay out of p99
+        assert len(raw) == 3
+        now = time.time()
+        assert all(len(p) == 2 and abs(now - p[0]) < 10.0 and p[1] >= 0
+                   for p in raw)
     finally:
         for b in app._batchers.values():
             b.close(drain=True)

@@ -90,7 +90,7 @@ def _rung_entry(rung, qps, p99, retraces=0, downgraded=False,
 
 
 def _rungs_artifact(tmp_path, rnd, rungs, metric="serve_req_per_sec_x_gbdt",
-                    binned_band=0.0, bf16=None, fleet=None):
+                    binned_band=0.0, bf16=None, fleet=None, tracing=None):
     default = next(r for r in rungs if r["rung"] == "default")
     rec = {
         "schema_version": 3,
@@ -105,6 +105,8 @@ def _rungs_artifact(tmp_path, rnd, rungs, metric="serve_req_per_sec_x_gbdt",
     }
     if fleet is not None:
         rec["fleet"] = fleet
+    if tracing is not None:
+        rec["tracing_overhead"] = tracing
     (tmp_path / f"SERVE_r{rnd:02d}.json").write_text(json.dumps(rec))
 
 
@@ -151,6 +153,34 @@ def test_gate_fails_on_recorded_bf16_band(tmp_path, capsys):
     ], bf16={"ffm": 0.4})
     assert gate_main(["--dir", str(tmp_path)]) == 1
     assert "bf16 band" in capsys.readouterr().err
+
+
+def test_gate_skips_artifact_predating_tracing_overhead(tmp_path, capsys):
+    """A serve_rungs artifact without the r17 tracing_overhead field must
+    skip the tracing gate cleanly (pre-field artifacts keep passing)."""
+    _rungs_artifact(tmp_path, 16, [_rung_entry("default", 10000.0, 20.0)])
+    assert gate_main(["--dir", str(tmp_path)]) == 0
+    assert "predates the field (skip)" in capsys.readouterr().out
+
+
+def test_gate_fails_on_sampled_tracing_out_of_band(tmp_path, capsys):
+    _rungs_artifact(
+        tmp_path, 17, [_rung_entry("default", 10000.0, 20.0)],
+        tracing={"off_req_per_sec": 10000.0, "sampled_req_per_sec": 7000.0,
+                 "always_req_per_sec": 6000.0, "sample_rate": 0.01},
+    )
+    assert gate_main(["--dir", str(tmp_path)]) == 1
+    assert "sampled tracing overhead out of band" in capsys.readouterr().err
+
+
+def test_gate_passes_sampled_tracing_within_band(tmp_path, capsys):
+    _rungs_artifact(
+        tmp_path, 17, [_rung_entry("default", 10000.0, 20.0)],
+        tracing={"off_req_per_sec": 10000.0, "sampled_req_per_sec": 9400.0,
+                 "always_req_per_sec": 8600.0, "sample_rate": 0.01},
+    )
+    assert gate_main(["--dir", str(tmp_path)]) == 0
+    assert "tracing overhead (r17)" in capsys.readouterr().out
 
 
 def _fleet_artifact(tmp_path, rnd, qps, p99, replicas=4,

@@ -1,0 +1,620 @@
+"""Request tracing, metrics history, and SLO burn-rate tests (ISSUE 13).
+
+Unit coverage for obs/trace.py (deterministic head sampler, hop
+recording, tail-based exemplar retention), the Registry history plane +
+heartbeat sampler, health.SLOBurnSentinel, the (ts, ms) latency-ring
+satellite, and the obs_report waterfall/sparkline rendering — plus one
+end-to-end real fleet test proving a single trace id spans
+front -> replica with correctly nested per-hop spans.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from serve_models import build_linear
+from test_serve import _load_prebuilt
+from ytklearn_tpu import obs
+from ytklearn_tpu.obs import health as obs_health
+from ytklearn_tpu.obs import trace
+from ytklearn_tpu.obs.heartbeat import (
+    start_history_sampler,
+    stop_history_sampler,
+)
+from ytklearn_tpu.serve import BatchPolicy, FleetFront, ModelRegistry, ServeApp
+from ytklearn_tpu.serve.batcher import DeadlineExceeded, OverloadError
+from ytklearn_tpu.serve.server import _LatencyWindow
+from ytklearn_tpu.serve.fleet.front import window_ring_ms
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+STUB = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                    "fleet_stub_worker.py")
+LADDER = (1, 4, 16)
+
+
+@pytest.fixture()
+def obs_on():
+    obs.configure(enabled=True)
+    obs.reset()
+    yield
+    obs.configure(enabled=False)
+    obs.reset()
+
+
+@pytest.fixture()
+def tracing():
+    """Arm the trace plane at sample=1, restore the env-default after."""
+    trace.configure_tracing(sample=1.0, seed=0, exemplars=256, slo_ms=0.0,
+                            reset=True)
+    yield
+    trace._configure_from_env()
+    trace.configure_tracing(slo_ms=0.0, reset=True)
+
+
+def _linear_app(tmp_path, **kw):
+    predictor, _names = build_linear(tmp_path)
+    reg = ModelRegistry(ladder=LADDER, watch_interval_s=0)
+    _load_prebuilt(reg, "default", predictor)
+    app = ServeApp(reg, kw.pop("policy", BatchPolicy(max_wait_ms=0.5)), **kw)
+    return app, reg
+
+
+def _close(app, reg):
+    for b in app._batchers.values():
+        b.close(drain=True)
+    reg.close()
+
+
+# ---------------------------------------------------------------------------
+# deterministic head sampler
+# ---------------------------------------------------------------------------
+
+
+def test_head_sampler_deterministic_same_seed_same_kept_set(tracing):
+    trace.configure_tracing(sample=0.5, seed=42)
+    first = [trace.head_keep(42, n) for n in range(1, 201)]
+    second = [trace.head_keep(42, n) for n in range(1, 201)]
+    assert first == second  # pure function of (seed, counter)
+    assert 40 < sum(first) < 160  # actually ~rate, not all/none
+    other = [trace.head_keep(43, n) for n in range(1, 201)]
+    assert other != first  # the seed matters
+    # the kept set through begin() follows the same draws exactly
+    trace.configure_tracing(sample=0.5, seed=42, reset=True)
+    via_begin = [trace.begin() is not trace.NOOP_TRACE
+                 for _ in range(200)]
+    assert via_begin == first
+
+
+def test_rate_bounds(tracing):
+    trace.configure_tracing(sample=1.0, reset=True)
+    assert all(trace.begin() is not trace.NOOP_TRACE for _ in range(20))
+    trace.configure_tracing(sample=0.0)
+    assert trace.begin() is trace.NOOP_TRACE  # plane off entirely
+    assert trace.finish(trace.NOOP_TRACE, status=429, latency_ms=1.0) is None
+
+
+def test_adopt_inbound_header_ids(tracing):
+    ctx = trace.begin(inbound="abc, def")
+    assert ctx.ids == ("abc", "def") and ctx.kept == "adopted"
+    with ctx.hop("serve.parse", rows=2):
+        pass
+    rec = trace.finish(ctx, status=200, latency_ms=1.5, rows=2)
+    assert rec["trace_id"] == "abc"
+    assert rec["trace_ids"] == ["abc", "def"]
+    assert [h["name"] for h in rec["hops"]] == ["serve.parse"]
+    assert trace.exemplars()[-1]["trace_id"] == "abc"
+
+
+# ---------------------------------------------------------------------------
+# tail-based exemplar retention
+# ---------------------------------------------------------------------------
+
+
+def test_tail_rules_keep_shed_deadline_and_slo(obs_on, tracing):
+    # armed but head-sampling ~nothing: only the tail rule admits
+    trace.configure_tracing(sample=1e-12, slo_ms=10.0, reset=True)
+    assert trace.finish(trace.NOOP_TRACE, status=200, latency_ms=1.0) is None
+    shed = trace.finish(trace.NOOP_TRACE, status=429, latency_ms=0.5)
+    dead = trace.finish(trace.NOOP_TRACE, status=504, latency_ms=20.0)
+    slow = trace.finish(trace.NOOP_TRACE, status=200, latency_ms=11.0)
+    assert shed["kept"] == "tail_shed" and shed["status"] == 429
+    assert dead["kept"] == "tail_deadline"
+    assert slow["kept"] == "tail_slo"
+    assert [r["kept"] for r in trace.exemplars()] == [
+        "tail_shed", "tail_deadline", "tail_slo"
+    ]
+    # every tail record gets a UNIQUE id (a same-millisecond shed storm
+    # must not collapse under one trace_id in a keyed consumer)
+    ids = [r["trace_id"] for r in trace.exemplars()]
+    assert len(set(ids)) == len(ids)
+    snap = obs.snapshot()["counters"]
+    assert snap.get("trace.kept.tail_shed") == 1
+
+
+def test_head_sampled_slo_violation_upgrades_kept_reason(tracing):
+    trace.configure_tracing(sample=1.0, slo_ms=5.0, reset=True)
+    ctx = trace.begin()
+    rec = trace.finish(ctx, status=200, latency_ms=50.0, rows=1)
+    assert rec["kept"] == "tail_slo"  # sampled AND violating: tail wins
+    assert rec["hops"] == []
+
+
+def test_exemplar_ring_bounded(tracing):
+    trace.configure_tracing(sample=1.0, exemplars=8, reset=True)
+    for _ in range(30):
+        trace.finish(trace.begin(), status=200, latency_ms=0.1)
+    assert len(trace.exemplars()) == 8
+    payload = trace.exemplars_payload()
+    assert payload["ring_capacity"] == 8
+    assert payload["schema"] == "ytk_traces"
+    assert "wall_t0" in payload
+
+
+# ---------------------------------------------------------------------------
+# ServeApp integration: per-hop spans, tail retention, slo burn
+# ---------------------------------------------------------------------------
+
+
+def test_serveapp_traced_request_hops(tmp_path, obs_on, tracing):
+    app, reg = _linear_app(tmp_path, cache_rows=8)
+    try:
+        app.predict([{"c0": 1.0}], timeout=10.0)
+        rec = trace.exemplars()[-1]
+        names = [h["name"] for h in rec["hops"]]
+        assert names[0] == "serve.cache"
+        for expected in ("serve.queue", "serve.assemble", "serve.execute"):
+            assert expected in names
+        execute = next(h for h in rec["hops"]
+                       if h["name"] == "serve.execute")
+        # the execute hop is tagged with the EFFECTIVE rung
+        assert execute["args"]["rung"] in LADDER
+        assert execute["args"]["mode"] == "stacked"
+        assert rec["status"] == 200 and rec["kept"] == "head"
+        # hop durations are a decomposition OF the latency, never more
+        # than marginally above it (hops can't overlap-measure here)
+        assert sum(h["dur_ms"] for h in rec["hops"]) <= rec["latency_ms"] * 1.2
+        # cache hit exemplar: the hit hop replaces the scored pipeline
+        app.predict([{"c0": 1.0}], timeout=10.0)
+        hit = trace.exemplars()[-1]
+        assert [h["name"] for h in hit["hops"]] == ["serve.cache"]
+        assert hit["hops"][0]["args"]["hit"] is True
+        assert hit["args"]["cached"] is True
+    finally:
+        _close(app, reg)
+
+
+def test_serveapp_shed_and_deadline_always_retained(tmp_path, obs_on, tracing):
+    # head sampler keeps ~nothing; the tail rule must still retain both
+    trace.configure_tracing(sample=1e-12, reset=True)
+    app, reg = _linear_app(
+        tmp_path, policy=BatchPolicy(max_wait_ms=0.5, max_queue=1)
+    )
+    try:
+        with pytest.raises(DeadlineExceeded):
+            app.predict([{"c0": 2.0}], deadline_ms=1e-4, timeout=10.0)
+        b = app.batcher_for("default")
+        with pytest.raises(OverloadError):
+            for i in range(200):
+                b.submit([{"c0": float(i)}])
+        with pytest.raises(OverloadError):
+            app.predict([{"c0": 9.0}], timeout=5.0)
+        kept = [r["kept"] for r in trace.exemplars()]
+        assert "tail_deadline" in kept and "tail_shed" in kept
+    finally:
+        _close(app, reg)
+
+
+def test_serveapp_slo_burn_fires_and_is_strict_escalatable(
+    tmp_path, obs_on, tracing, monkeypatch
+):
+    monkeypatch.setenv("YTK_SLO_BURN_WINDOW", "8")
+    monkeypatch.setenv("YTK_SLO_BURN_BUDGET", "0.5")
+    app, reg = _linear_app(tmp_path, slo_ms=1e-4)  # every request violates
+    try:
+        for i in range(8):
+            app.predict([{"c0": float(i)}], timeout=10.0)
+        snap = obs.snapshot()["counters"]
+        assert snap.get("health.slo_burn") == 1
+        assert snap.get("health.slo_burn.serve.predict") == 1
+        ev = [e for e in obs.REGISTRY.events
+              if e.get("name") == "health.slo_burn"]
+        assert ev and ev[-1]["args"]["rate"] == 1.0
+        assert ev[-1]["args"]["window"] == 8
+        # window re-arms: a second full window fires again
+        for i in range(8):
+            app.predict([{"c0": float(i)}], timeout=10.0)
+        assert obs.snapshot()["counters"]["health.slo_burn"] == 2
+    finally:
+        _close(app, reg)
+
+
+def test_serveapp_failed_request_still_lands_as_500_exemplar(
+    tmp_path, obs_on, tracing
+):
+    """An owned head-sampled trace of a request that dies on a generic
+    scorer error must close as a status-500 exemplar, not leak."""
+    app, reg = _linear_app(tmp_path)
+    try:
+        entry = reg.get("default")
+        def boom(rows):
+            raise RuntimeError("scorer exploded")
+        entry.scorer.score_and_predict = boom
+        with pytest.raises(RuntimeError):
+            app.predict([{"c0": 1.0}], timeout=10.0)
+        rec = trace.exemplars()[-1]
+        assert rec["status"] == 500 and rec["kept"] == "head"
+        assert "serve.queue" in [h["name"] for h in rec["hops"]]
+    finally:
+        _close(app, reg)
+
+
+def test_slo_burn_zero_budget_env_is_honored(monkeypatch):
+    """YTK_SLO_BURN_BUDGET=0 means zero tolerance — it must not be
+    clobbered by a truthiness fallback to the default."""
+    monkeypatch.setenv("YTK_SLO_BURN_BUDGET", "0")
+    monkeypatch.setenv("YTK_SLO_BURN_WINDOW", "4")
+    obs_health.configure_health(on=True)
+    s = obs_health.SLOBurnSentinel("t.zero", slo_ms=10.0)
+    assert s.budget == 0.0 and s.window == 4
+    for i in range(4):
+        ok = s.observe(50.0 if i == 0 else 1.0)  # ONE violation in window
+    assert ok is False and s.windows_fired == 1
+
+
+def test_slo_burn_sentinel_budget_and_strict():
+    obs_health.configure_health(on=True)
+    s = obs_health.SLOBurnSentinel("t.site", slo_ms=10.0, window=10,
+                                   budget=0.3)
+    # 2/10 violations = under the 30% budget: no fire
+    for i in range(10):
+        assert s.observe(50.0 if i < 2 else 1.0) is True
+    assert s.windows_fired == 0
+    # 4/10 violations (mix of latency and explicit shed): fires
+    for i in range(10):
+        if i < 2:
+            ok = s.observe(50.0)
+        elif i < 4:
+            ok = s.observe(violated=True)  # a shed burns budget too
+        else:
+            ok = s.observe(1.0)
+    assert ok is False and s.windows_fired == 1
+    # strict escalation carries the flight-dump contract
+    obs_health.configure_health(strict=True)
+    try:
+        with pytest.raises(obs_health.HealthError):
+            for _ in range(10):
+                s.observe(99.0)
+    finally:
+        obs_health.configure_health(strict=False)
+
+
+# ---------------------------------------------------------------------------
+# (ts, ms) latency ring + windowed fleet union (satellite fix)
+# ---------------------------------------------------------------------------
+
+
+def test_latency_ring_exports_ts_ms_pairs():
+    w = _LatencyWindow(maxlen=8)
+    w.record(5.0)
+    w.record(7.5)
+    raw = w.raw()
+    assert all(len(p) == 2 for p in raw)
+    now = time.time()
+    assert all(abs(now - p[0]) < 5.0 for p in raw)
+    assert [p[1] for p in raw] == [5.0, 7.5]
+    assert w.percentiles()["count"] == 2  # percentiles over ms only
+
+
+def test_window_ring_union_drops_stale_samples():
+    now = time.time()
+    raw = [[now - 1.0, 5.0], [now - 120.0, 500.0], [now - 2.0, 7.0]]
+    # the idle replica's 2-minute-old 500ms sample must NOT dilute p99
+    assert window_ring_ms(raw, now, window_s=60.0) == [5.0, 7.0]
+    # legacy bare floats (pre-r17 replica mid-upgrade) pass through
+    assert window_ring_ms([3.0, [now, 4.0]], now, window_s=60.0) == [3.0, 4.0]
+
+
+# ---------------------------------------------------------------------------
+# metrics history plane
+# ---------------------------------------------------------------------------
+
+
+def test_history_rings_bounded_and_snapshotted(obs_on):
+    obs.REGISTRY.enable_history(3)
+    try:
+        obs.inc("t.counter", 1)
+        obs.gauge("t.gauge", 2.5)
+        for i in range(5):
+            obs.inc("t.counter", 1)
+            obs.REGISTRY.sample_history(now=1000.0 + i)
+        snap = obs.REGISTRY.history_snapshot()
+        assert snap["ring_n"] == 3
+        series = snap["series"]
+        assert len(series["t.counter"]) == 3  # bounded
+        # newest samples survive, (ts, value) pairs
+        assert series["t.counter"][-1] == [1004.0, 6.0]
+        assert series["t.gauge"][-1][1] == 2.5
+    finally:
+        obs.REGISTRY.disable_history()
+
+
+def test_metrics_payload_history_export(tmp_path, obs_on):
+    app, reg = _linear_app(tmp_path)
+    try:
+        assert "history" not in app.metrics_payload()
+        assert app.metrics_payload(history=True)["history"] == {}
+        obs.REGISTRY.enable_history(16)
+        app.predict([{"c0": 1.0}], timeout=10.0)
+        obs.REGISTRY.sample_history()
+        hist = app.metrics_payload(history=True)["history"]
+        assert "serve.requests" in hist["series"]
+    finally:
+        obs.REGISTRY.disable_history()
+        _close(app, reg)
+
+
+@pytest.mark.threaded
+def test_history_sampler_thread(obs_on):
+    assert start_history_sampler(interval_s=0.03, ring_n=16) is True
+    try:
+        deadline = time.time() + 3.0
+        while time.time() < deadline:
+            obs.inc("t.sampled", 1)
+            snap = obs.REGISTRY.history_snapshot()
+            if snap and len(snap["series"].get("t.sampled", [])) >= 2:
+                break
+            time.sleep(0.01)
+        series = obs.REGISTRY.history_snapshot()["series"]
+        assert len(series["t.sampled"]) >= 2  # the thread is sampling
+    finally:
+        stop_history_sampler()
+    assert obs.REGISTRY.history_snapshot() is None  # disabled on stop
+
+
+@pytest.mark.threaded
+def test_exemplar_ring_concurrent_writers_and_readers(obs_on, tracing):
+    trace.configure_tracing(sample=1.0, exemplars=64, reset=True)
+    errors = []
+
+    def writer(k):
+        try:
+            for _ in range(200):
+                ctx = trace.begin()
+                with ctx.hop("t.hop", k=k):
+                    pass
+                trace.finish(ctx, status=200, latency_ms=0.1, rows=1)
+        except Exception as e:  # noqa: BLE001 — collected for the assert
+            errors.append(e)
+
+    threads = [threading.Thread(target=writer, args=(k,)) for k in range(4)]
+    for t in threads:
+        t.start()
+    # reader concurrent with the writers: every snapshot stays bounded
+    while any(t.is_alive() for t in threads):
+        payload = trace.exemplars_payload()
+        assert len(payload["exemplars"]) <= 64
+    for t in threads:
+        t.join(timeout=20.0)
+    assert not errors
+    assert len(trace.exemplars()) == 64  # 800 writes through a 64 ring
+
+
+# ---------------------------------------------------------------------------
+# fleet front over stub workers
+# ---------------------------------------------------------------------------
+
+
+def test_front_trace_hops_and_fleet_traces_payload(obs_on, tracing):
+    front = FleetFront(
+        [sys.executable, STUB, "--weight", "2.0"], 1,
+        policy=BatchPolicy(max_batch=64, max_wait_ms=0.5, max_queue=4096),
+        ready_timeout_s=30.0, monitor_interval_s=0.1,
+    ).start()
+    try:
+        for i in range(3):
+            front.predict([{"x": float(i)}], timeout=15.0)
+        rec = trace.exemplars()[-1]
+        names = [h["name"] for h in rec["hops"]]
+        for expected in ("front.queue", "front.forward"):
+            assert expected in names
+        fwd = next(h for h in rec["hops"] if h["name"] == "front.forward")
+        assert fwd["args"]["replica"] == 0
+        tp = front.traces_payload()
+        assert tp["schema"] == "ytk_traces" and tp["fleet"] is True
+        assert tp["front"]["exemplars"]
+        # the stub speaks the contract: its (empty) ring + wall_t0 land
+        assert tp["replicas"]["0"]["schema"] == "ytk_traces"
+        assert "wall_t0" in tp["replicas"]["0"]
+    finally:
+        front.stop(drain=True, timeout=15.0)
+
+
+# ---------------------------------------------------------------------------
+# obs_report: waterfall, sparklines, perfetto merge
+# ---------------------------------------------------------------------------
+
+
+def _fake_traces_doc():
+    mk = lambda name, ts, dur, **args: {  # noqa: E731
+        "name": name, "ts": ts, "dur_ms": dur,
+        **({"args": args} if args else {}),
+    }
+    front_ex = []
+    for i in range(20):
+        lat = 4.0 + i  # deterministic spread; #19 is the p99 pick
+        front_ex.append({
+            "trace_id": f"t-{i}", "ts": 1.0 + i, "kept": "head",
+            "status": 200, "latency_ms": lat, "rows": 1,
+            "hops": [
+                mk("front.parse", 1.0 + i, 0.2),
+                mk("front.queue", 1.0002 + i, 1.0),
+                mk("front.forward", 1.0012 + i, lat - 1.5, replica=0),
+                mk("front.write", 1.0 + i + (lat - 0.3) / 1e3, 0.3),
+            ],
+        })
+    # t-19's front.forward: front-clock ts 20.0012 s, 21.5 ms long. The
+    # replica clock origin is 1001.5023 wall, so hops at replica-clock
+    # ~18.5 s land INSIDE that window once both anchor to the wall clock.
+    rep_ex = [{
+        "trace_id": "t-19", "ts": 18.4994, "kept": "adopted", "status": 200,
+        "latency_ms": 19.0, "rows": 1,
+        "hops": [mk("serve.queue", 18.4994, 0.5),
+                 mk("serve.execute", 18.5, 18.0, rung=64)],
+    }]
+    return {
+        "schema": "ytk_traces", "schema_version": 1, "fleet": True,
+        "front": {"schema": "ytk_traces", "pid": 100, "wall_t0": 1000.0,
+                  "sample": 1.0, "identity": {}, "exemplars": front_ex},
+        "replicas": {"0": {"schema": "ytk_traces", "pid": 101,
+                           "wall_t0": 1001.5023,
+                           "identity": {"replica_id": 0},
+                           "exemplars": rep_ex}},
+    }
+
+
+def test_obs_report_waterfall_and_perfetto(tmp_path, capsys):
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    import obs_report
+
+    doc = _fake_traces_doc()
+    path = tmp_path / "traces.json"
+    path.write_text(json.dumps(doc))
+    merged = tmp_path / "merged.json"
+    assert obs_report.main([str(path), "--perfetto", str(merged)]) == 0
+    out = capsys.readouterr().out
+    assert "request-trace waterfall" in out
+    assert "p99 lives in: front.forward" in out
+    assert "p99 exemplar t-19" in out
+    assert "replica 0" in out  # the replica-side hops render nested
+    assert "front-side hop sum" in out
+    doc2 = json.loads(merged.read_text())
+    evs = doc2["traceEvents"]
+    # every process lane + every hop is in the merged Perfetto trace
+    assert {e["pid"] for e in evs} == {100, 101}
+    x = [e for e in evs if e["ph"] == "X"]
+    assert len(x) == 20 + 4 * 20 + 1 + 2  # requests + hops, both sides
+    # clock alignment: the replica's serve.execute sits inside t-19's
+    # front.forward window on the merged (front-anchored) timeline
+    fwd = next(e for e in x if e["name"] == "front.forward"
+               and e["args"].get("trace_id") == "t-19")
+    ex = next(e for e in x if e["name"] == "serve.execute")
+    assert fwd["ts"] <= ex["ts"] <= fwd["ts"] + fwd["dur"]
+
+
+def test_obs_report_history_sparklines(tmp_path, capsys):
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    import obs_report
+
+    doc = {
+        "replica": {"replica_id": 0, "pid": 1},
+        "latency": {"count": 3},
+        "counters": {"serve.requests": 64.0},
+        "gauges": {},
+        "history": {"ring_n": 8, "series": {
+            "serve.requests": [[1000.0 + i, float(i * i)] for i in range(8)],
+            "serve.queue_depth": [[1000.0 + i, float(8 - i)]
+                                  for i in range(8)],
+            "flat.metric": [[1000.0 + i, 3.0] for i in range(8)],
+        }},
+    }
+    path = tmp_path / "metrics.json"
+    path.write_text(json.dumps(doc))
+    assert obs_report.main([str(path)]) == 0
+    out = capsys.readouterr().out
+    assert "metrics history (sparklines" in out
+    assert "serve.requests" in out and "Δ" in out  # counter -> deltas
+    assert "flat.metric" not in out  # flat non-health series elided
+
+
+# ---------------------------------------------------------------------------
+# the real thing: trace id spans front -> replica over a live fleet
+# ---------------------------------------------------------------------------
+
+
+def test_e2e_fleet_trace_propagation(tmp_path):
+    """Boot a real 1-replica fleet (full jax worker) with tracing armed:
+    a client-supplied trace id must appear in BOTH the front's and the
+    replica's exemplar rings, with the replica's hops clock-aligned
+    inside the front.forward hop (wall_t0 banner handshake), and the
+    front must serve /metrics?history=1."""
+    (tmp_path / "cli.model").write_text("c0,2.000000,1.0\n_bias_,0.0\n")
+    conf = tmp_path / "serve.conf"
+    conf.write_text(json.dumps({
+        "model": {"data_path": str(tmp_path / "cli.model")},
+        "loss": {"loss_function": "sigmoid"},
+    }))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", YTK_TRACE_SAMPLE="1",
+               YTK_OBS="1", YTK_OBS_HISTORY_S="0.1")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ytklearn_tpu.cli", "serve", str(conf),
+         "linear", "--port", "0", "--host", "127.0.0.1", "--replicas", "1",
+         "--ladder", "1,4", "--watch-interval", "0"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=env, text=True,
+    )
+
+    def _http(method, port, path, payload=None, headers=None):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}",
+            data=json.dumps(payload).encode() if payload is not None else None,
+            headers={"Content-Type": "application/json", **(headers or {})},
+            method=method,
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=30.0) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    try:
+        banner = json.loads(proc.stdout.readline())
+        assert "wall_t0" in banner  # the clock handshake rides the banner
+        port = banner["port"]
+        code, out = _http("POST", port, "/predict", {"rows": [{"c0": 1.5}]},
+                          headers={trace.TRACE_HEADER: "e2e-abc"})
+        assert code == 200 and out["scores"] == [pytest.approx(3.0)]
+        time.sleep(1.0)  # replica ring settle + a history tick
+        code, tp = _http("GET", port, "/admin/traces")
+        assert code == 200 and tp["schema"] == "ytk_traces"
+        mine = [r for r in tp["front"]["exemplars"]
+                if r["trace_id"] == "e2e-abc"]
+        assert mine, "client trace id missing from the front ring"
+        front_hops = [h["name"] for h in mine[0]["hops"]]
+        for expected in ("front.parse", "front.queue", "front.forward",
+                         "front.write"):
+            assert expected in front_hops
+        rep = tp["replicas"]["0"]
+        rep_ex = [r for r in rep.get("exemplars", [])
+                  if r.get("trace_id") == "e2e-abc"
+                  or "e2e-abc" in (r.get("trace_ids") or [])]
+        assert rep_ex, "trace id did not propagate to the replica"
+        rep_hops = [h["name"] for h in rep_ex[0]["hops"]]
+        for expected in ("serve.parse", "serve.queue", "serve.assemble",
+                         "serve.execute", "serve.write"):
+            assert expected in rep_hops
+        # nesting: every replica hop starts inside the front.forward
+        # window once both sides are anchored to the wall clock
+        fwd = next(h for h in mine[0]["hops"]
+                   if h["name"] == "front.forward")
+        fwd_start = tp["front"]["wall_t0"] + fwd["ts"]
+        fwd_end = fwd_start + fwd["dur_ms"] / 1e3
+        starts = [rep["wall_t0"] + h["ts"] for h in rep_ex[0]["hops"]]
+        assert min(starts) >= fwd_start - 0.05
+        assert max(starts) <= fwd_end + 0.05
+        # metrics history plane over HTTP
+        code, m = _http("GET", port, "/metrics?history=1")
+        assert code == 200 and "series" in (m.get("history") or {})
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=60.0) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10.0)

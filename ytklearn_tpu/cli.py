@@ -567,6 +567,10 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
         "port": app.port,
         "replica_id": args.replica_id,
         "ladder": list(registry.get(args.name).scorer.ladder),
+        # monotonic-offset handshake: this process's obs clock origin on
+        # the wall clock — the fleet front stamps it on the replica handle
+        # so cross-process trace merges stay aligned (obs/trace.py)
+        "wall_t0": obs.core.WALL_T0,
     }), flush=True)
     try:
         while app._serve_thread is not None and app._serve_thread.is_alive():
@@ -617,9 +621,12 @@ def _serve_fleet_main(args, replicas: int, slo_ms, cache_rows) -> int:
         ),
         host=args.host,
         port=args.port,
+        slo_ms=slo_ms,
     )
     front.start().serve_http()
     front.install_signal_handlers()
+    from . import obs
+
     print(json.dumps({
         "serving": args.name,
         "model": args.model_name,
@@ -630,6 +637,7 @@ def _serve_fleet_main(args, replicas: int, slo_ms, cache_rows) -> int:
         "replica_ports": {
             str(rid): h.port for rid, h in sorted(front.handles.items())
         },
+        "wall_t0": obs.core.WALL_T0,
     }), flush=True)
     try:
         while front._serve_thread is not None and front._serve_thread.is_alive():

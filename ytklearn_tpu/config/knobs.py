@@ -135,6 +135,25 @@ _knob("YTK_TRACE", "str", None,
       "enable obs + write a Chrome-trace/Perfetto JSON to this path at exit")
 _knob("YTK_TRACE_JSONL", "str", None,
       "enable obs + write the JSONL event stream to this path at exit")
+_knob("YTK_TRACE_SAMPLE", "float", 0.01,
+      "serve-side request-tracing head-sample rate: the fraction of "
+      "/predict requests whose per-hop spans are recorded and kept as "
+      "exemplars (deterministic counter-hashed draws; `0` disables the "
+      "tracing plane, `1` = always-on — see "
+      "[observability.md](observability.md))")
+_knob("YTK_TRACE_SEED", "int", 0,
+      "seed for the deterministic trace head sampler (same seed + same "
+      "request order = same kept set)")
+_knob("YTK_TRACE_EXEMPLARS", "int", 256,
+      "per-process exemplar-ring capacity (kept request traces), exported "
+      "at `/admin/traces`; shed/504/SLO-violating requests are always "
+      "retained, head-sampled ones ride the ring too")
+_knob("YTK_OBS_HISTORY_N", "int", 256,
+      "per-metric time-series ring length for the metrics history plane "
+      "(`/metrics?history=1`); `0` disables history sampling")
+_knob("YTK_OBS_HISTORY_S", "float", 1.0,
+      "metrics-history sampling interval in seconds (the obs heartbeat "
+      "sampler thread snapshots every counter/gauge this often)")
 
 # -- run health -------------------------------------------------------------
 _knob("YTK_HEALTH", "bool", True,
@@ -145,6 +164,13 @@ _knob("YTK_HEALTH_STRICT", "bool", False,
       "(unattended production runs)")
 _knob("YTK_HEALTH_INGEST_TOL", "float", 0.01,
       "ingest error-rate threshold (fraction) for the parse sentinel")
+_knob("YTK_SLO_BURN_WINDOW", "int", 256,
+      "requests per SLO burn-rate window: the `health.slo_burn` sentinel "
+      "judges the violation rate once per full window")
+_knob("YTK_SLO_BURN_BUDGET", "float", 0.1,
+      "SLO error budget as a windowed violation-rate fraction: when more "
+      "than this fraction of a window's requests exceed the SLO (or are "
+      "shed/504'd), `health.slo_burn` fires (strict mode escalates)")
 _knob("YTK_FLIGHT", "bool", True,
       "flight-recorder auto-install in trainers; `0` opts out")
 _knob("YTK_FLIGHT_N", "int", 4096,

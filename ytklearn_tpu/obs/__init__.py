@@ -21,6 +21,21 @@ Run-health layer (obs/health.py, obs/recorder.py — docs/observability.md):
                                      postmortem flight_<ts>.json dump on
                                      abnormal exit; YTK_FLIGHT_* knobs
   HealthError                        strict-mode sentinel escalation
+  SLOBurnSentinel                    serving SLO burn-rate alarm
+                                     (health.slo_burn)
+
+Serve-side request tracing + metrics history (obs/trace.py,
+Registry.history — docs/observability.md "Request tracing"):
+
+  trace                              per-hop request tracing: deterministic
+                                     head sampler, X-Ytk-Trace context
+                                     propagation, tail-retained exemplar
+                                     ring (/admin/traces);
+                                     YTK_TRACE_SAMPLE / _SEED / _EXEMPLARS
+  start_history_sampler              per-metric (ts, value) rings sampled
+                                     by the obs heartbeat thread, exported
+                                     at /metrics?history=1;
+                                     YTK_OBS_HISTORY_{N,S}
 """
 
 from .core import (  # noqa: F401
@@ -42,10 +57,17 @@ from .core import (  # noqa: F401
 )
 from .export import (  # noqa: F401
     chrome_trace_events,
+    exemplar_trace_events,
     export_chrome_trace,
     export_jsonl,
     load_jsonl,
 )
-from .heartbeat import Heartbeat, heartbeat  # noqa: F401
-from . import health, recorder  # noqa: F401
-from .health import HealthError  # noqa: F401
+from .heartbeat import (  # noqa: F401
+    Heartbeat,
+    heartbeat,
+    start_history_sampler,
+    stop_history_sampler,
+)
+from . import health, recorder, trace  # noqa: F401
+from .health import HealthError, SLOBurnSentinel  # noqa: F401
+from .trace import TRACE_HEADER, configure_tracing  # noqa: F401

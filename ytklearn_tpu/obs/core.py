@@ -30,6 +30,7 @@ Env knobs (read once at import; `configure()` overrides at runtime):
 
 from __future__ import annotations
 
+import collections
 import math
 import os
 import threading
@@ -67,6 +68,13 @@ class Registry:
         # recorder installs so the last N events survive for a postmortem
         # dump even though `events` may be huge. None when not installed.
         self.ring = None
+        # metrics history plane: per-metric bounded (wall_ts, value) rings
+        # fed by sample_history() (the heartbeat sampler thread) so every
+        # counter/gauge has a recent time series, not just a point-in-time
+        # value. None until enable_history(); bounded per metric by
+        # YTK_OBS_HISTORY_N. /metrics?history=1 exports it.
+        self.history = None
+        self._history_n = 0
         self._tls = threading.local()
 
     def _stack(self) -> list:
@@ -105,6 +113,62 @@ class Registry:
             self.events.clear()
             if self.ring is not None:
                 self.ring.clear()
+            if self.history is not None:
+                self.history.clear()
+
+    # -- metrics history plane -------------------------------------------
+
+    def enable_history(self, n: int) -> None:
+        """Arm per-metric time-series rings of length `n` (idempotent at
+        the same capacity; re-arming at a new capacity starts fresh)."""
+        with self._lock:
+            if self.history is None or self._history_n != n:
+                self.history = {}
+                self._history_n = max(1, int(n))
+
+    def disable_history(self) -> None:
+        with self._lock:
+            self.history = None
+            self._history_n = 0
+
+    def sample_history(self, now: Optional[float] = None) -> None:
+        """Append one (wall_ts, value) sample per live counter/gauge. One
+        lock hold, dict-scan cost — called at the history interval (1 s
+        default), never per request/row."""
+        if self.history is None:
+            return
+        if now is None:
+            now = time.time()
+        ts = round(now, 3)
+        with self._lock:
+            hist = self.history
+            if hist is None:  # disabled between check and lock
+                return
+            n = self._history_n
+            for name, value in self.counters.items():
+                ring = hist.get(name)
+                if ring is None:
+                    ring = hist[name] = collections.deque(maxlen=n)
+                ring.append((ts, value))
+            for name, value in self.gauges.items():
+                ring = hist.get(name)
+                if ring is None:
+                    ring = hist[name] = collections.deque(maxlen=n)
+                ring.append((ts, value))
+
+    def history_snapshot(self) -> Optional[dict]:
+        """{"series": {name: [[wall_ts, value], ...]}} or None when the
+        history plane is off."""
+        with self._lock:
+            if self.history is None:
+                return None
+            return {
+                "ring_n": self._history_n,
+                "series": {
+                    name: [[t, v] for t, v in ring]
+                    for name, ring in sorted(self.history.items())
+                },
+            }
 
 
 REGISTRY = Registry()

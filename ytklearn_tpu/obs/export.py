@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import json
 import os
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 from .core import REGISTRY, WALL_T0, Registry
 
@@ -96,6 +96,58 @@ def export_chrome_trace(path: str, registry: Registry = REGISTRY) -> str:
         json.dump(doc, f)
     os.replace(tmp, path)
     return path
+
+
+def exemplar_trace_events(
+    payloads: List[dict], align_wall_t0: Optional[float] = None
+) -> List[dict]:
+    """Merge per-process /admin/traces payloads (obs/trace.py
+    `exemplars_payload()`) into one clock-aligned Chrome-trace event list.
+
+    Each payload carries its process's `wall_t0` (the obs clock origin on
+    the wall clock); hop offsets become wall times and are re-anchored to
+    the EARLIEST origin across payloads, so front and replica spans of
+    one trace id line up on a single Perfetto timeline. Each process gets
+    its own pid lane; every exemplar contributes one enclosing span plus
+    its hops, all tagged with the trace id."""
+    if align_wall_t0 is None:
+        align_wall_t0 = min(
+            (p.get("wall_t0") or 0.0 for p in payloads), default=0.0
+        )
+    out: List[dict] = []
+    for p in payloads:
+        pid = p.get("pid") or 0
+        ident = p.get("identity") or {}
+        label = ("replica %s" % ident["replica_id"]
+                 if "replica_id" in ident else "front/solo")
+        out.append({
+            "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+            "args": {"name": f"ytk-serve {label} (pid {pid})"},
+        })
+        base_us = ((p.get("wall_t0") or 0.0) - align_wall_t0) * 1e6
+        for rec in p.get("exemplars") or []:
+            ts_us = base_us + rec.get("ts", 0.0) * 1e6
+            dur_us = rec.get("latency_ms", 0.0) * 1e3
+            args = {"trace_id": rec.get("trace_id"),
+                    "kept": rec.get("kept"),
+                    "status": rec.get("status")}
+            out.append({
+                "name": f"trace.request[{rec.get('kept')}]",
+                "cat": "trace", "ph": "X", "pid": pid, "tid": 0,
+                "ts": round(ts_us, 3), "dur": round(dur_us, 3),
+                "args": args,
+            })
+            for hop in rec.get("hops") or []:
+                h_args = dict(hop.get("args") or {})
+                h_args["trace_id"] = rec.get("trace_id")
+                out.append({
+                    "name": hop["name"], "cat": "trace.hop", "ph": "X",
+                    "pid": pid, "tid": 1,
+                    "ts": round(base_us + hop.get("ts", 0.0) * 1e6, 3),
+                    "dur": round(hop.get("dur_ms", 0.0) * 1e3, 3),
+                    "args": h_args,
+                })
+    return out
 
 
 def export_jsonl(path: str, registry: Registry = REGISTRY) -> str:

@@ -17,6 +17,10 @@ Sentinels (all fire `health.*` counters + an `obs.event`, and log):
                                  (YTK_HEALTH_INGEST_TOL, default 1%)
   check_tree(site, n_nodes, gains)  empty-tree / NaN-gain detection on the
                                  host-side tree conversion
+  SLOBurnSentinel(site, slo_ms)  serving SLO burn-rate: windowed request
+                                 violation rate over the error budget
+                                 fires `health.slo_burn`
+                                 (YTK_SLO_BURN_{WINDOW,BUDGET})
 
 Telemetry:
 
@@ -51,6 +55,7 @@ from __future__ import annotations
 import logging
 import math
 import os
+import threading
 from typing import Optional, Sequence
 
 from . import core, recorder
@@ -234,6 +239,91 @@ def check_tree(site: str, n_nodes: int, gains: Sequence[float], **args) -> bool:
         )
         ok = False
     return ok
+
+
+class SLOBurnSentinel:
+    """SLO burn-rate alarm for the serving layer (Clipper's SLO-first
+    argument applied to the r8 sentinel discipline): observe() every
+    request's client-visible latency (or an explicit violation — a shed
+    429 / deadline 504 burned budget without ever being scored), and once
+    per full window of `window` requests judge the violation rate against
+    the error `budget`. Crossing it fires `health.slo_burn` (counter +
+    flight-ring event naming the rate, window, and SLO; strict mode
+    escalates to HealthError like any other sentinel), then the window
+    re-arms so a sustained burn fires once per window, not per request.
+
+    Thread-safe: handler threads observe concurrently; the counters are
+    advanced under a tiny lock and the fire happens OUTSIDE it (the
+    strict path writes a flight dump — IO under a request-path lock would
+    be a ytklint blocking-call-under-lock finding and a real stall).
+    """
+
+    __slots__ = ("site", "slo_ms", "window", "budget", "_viol", "_n",
+                 "_lock", "windows_fired")
+
+    def __init__(
+        self,
+        site: str,
+        slo_ms: float,
+        window: Optional[int] = None,
+        budget: Optional[float] = None,
+    ):
+        self.site = site
+        self.slo_ms = float(slo_ms)
+        # no `or`-fallbacks here: the knobs carry declared defaults, and
+        # an explicit 0 budget (zero-tolerance) must survive as 0
+        self.window = max(1, int(
+            window if window is not None
+            else knobs.get_int("YTK_SLO_BURN_WINDOW")
+        ))
+        self.budget = float(
+            budget if budget is not None
+            else knobs.get_float("YTK_SLO_BURN_BUDGET")
+        )
+        self._viol = 0
+        self._n = 0
+        self._lock = threading.Lock()
+        self.windows_fired = 0
+
+    def observe(
+        self, latency_ms: Optional[float] = None, violated: Optional[bool] = None,
+        **args,
+    ) -> bool:
+        """Feed one request. True = budget intact (or health off)."""
+        if not _state.on:
+            return True
+        if violated is None:
+            violated = latency_ms is not None and latency_ms > self.slo_ms
+        fire_rate = None
+        with self._lock:
+            self._n += 1
+            if violated:
+                self._viol += 1
+            if self._n >= self.window:
+                rate = self._viol / self._n
+                if rate > self.budget:
+                    fire_rate = rate
+                    # counted under the lock (a lockless += here is the
+                    # r14 _inflight lost-update shape); only the _fire —
+                    # which may write a flight dump — stays outside
+                    self.windows_fired += 1
+                self._n = 0
+                self._viol = 0
+        if fire_rate is None:
+            return True
+        _fire(
+            "slo_burn",
+            self.site,
+            f"SLO burn: {100 * fire_rate:.1f}% of the last {self.window} "
+            f"requests violated the {self.slo_ms:g} ms SLO "
+            f"(budget {100 * self.budget:.1f}%)",
+            rate=round(fire_rate, 4),
+            window=self.window,
+            budget=self.budget,
+            slo_ms=self.slo_ms,
+            **args,
+        )
+        return False
 
 
 def root_health_counters(counters) -> dict:

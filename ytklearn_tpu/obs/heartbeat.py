@@ -19,10 +19,12 @@ reported as a negative rate), and never appear on the first beat.
 from __future__ import annotations
 
 import logging
+import threading
 import time
 from typing import Dict, Optional
 
 from . import core
+from ..config import knobs
 
 log = logging.getLogger("ytklearn_tpu.obs")
 
@@ -87,3 +89,64 @@ class Heartbeat:
 
 def heartbeat(name: str, every_s: float = 30.0, logger=None) -> Heartbeat:
     return Heartbeat(name, every_s=every_s, logger=logger)
+
+
+# ---------------------------------------------------------------------------
+# Metrics-history sampler: the obs heartbeat thread
+# ---------------------------------------------------------------------------
+
+#: the singleton sampler thread + its stop event; guarded by _sampler_lock
+#: (start is called from ServeApp/FleetFront start paths concurrently)
+_sampler: Optional[threading.Thread] = None
+_sampler_stop: Optional[threading.Event] = None
+_sampler_lock = threading.Lock()
+
+
+def _sampler_loop(stop: threading.Event, interval_s: float) -> None:
+    while not stop.wait(interval_s):
+        if core.enabled():
+            core.REGISTRY.sample_history()
+
+
+def start_history_sampler(
+    interval_s: Optional[float] = None, ring_n: Optional[int] = None
+) -> bool:
+    """Arm the metrics history plane: per-metric (ts, value) rings on the
+    registry plus one process-wide daemon thread sampling them every
+    `interval_s` (YTK_OBS_HISTORY_S). Idempotent — the serving layer calls
+    this at every start(). Returns True when the plane is armed, False
+    when YTK_OBS_HISTORY_N=0 disables it."""
+    global _sampler, _sampler_stop
+    n = ring_n if ring_n is not None else knobs.get_int("YTK_OBS_HISTORY_N")
+    if not n or n <= 0:
+        return False
+    every = (interval_s if interval_s is not None
+             else knobs.get_float("YTK_OBS_HISTORY_S")) or 1.0
+    core.REGISTRY.enable_history(n)
+    core.REGISTRY.sample_history()  # t=0 sample: history is never empty
+    with _sampler_lock:
+        if _sampler is not None and _sampler.is_alive():
+            return True
+        stop = threading.Event()
+        t = threading.Thread(
+            target=_sampler_loop, args=(stop, float(every)),
+            name="ytk-obs-history", daemon=True,
+        )
+        _sampler, _sampler_stop = t, stop
+        t.start()
+    return True
+
+
+def stop_history_sampler(disable: bool = True) -> None:
+    """Stop the sampler thread (joined) and, by default, drop the history
+    rings — test isolation; production processes just exit."""
+    global _sampler, _sampler_stop
+    with _sampler_lock:
+        t, stop = _sampler, _sampler_stop
+        _sampler, _sampler_stop = None, None
+    if stop is not None:
+        stop.set()
+    if t is not None:
+        t.join(timeout=10.0)
+    if disable:
+        core.REGISTRY.disable_history()

@@ -196,6 +196,17 @@ def _dump(reason: str, exc: Optional[BaseException]) -> str:
     }
     if exc is not None:
         flight["exception"] = f"{type(exc).__name__}: {exc}"[:1000]
+    try:
+        from . import trace as _trace
+
+        if _trace.enabled():
+            # a serving postmortem carries its tail exemplars: the slow /
+            # shed / 504'd request traces that were in the ring when the
+            # process died (obs/trace.py; empty list when none were kept)
+            flight["traces"] = _trace.exemplars()
+    # ytklint: allow(broad-except) reason=the flight dump must land even when the trace plane is the broken part
+    except Exception:
+        pass
 
     _state.dump_seq += 1
     ts = time.strftime("%Y%m%d-%H%M%S")

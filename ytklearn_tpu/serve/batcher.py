@@ -30,6 +30,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 import numpy as np
 
 from ..obs import event as obs_event, gauge as obs_gauge, inc as obs_inc, span as obs_span
+from ..obs import trace as obs_trace
 
 
 class OverloadError(RuntimeError):
@@ -67,16 +68,23 @@ class _Pending:
     budget (scripts/serve_bench.py --fleet found it)."""
 
     __slots__ = ("rows", "result", "meta", "_off", "error", "t_enq",
-                 "deadline", "_done", "_event", "_sig")
+                 "t_done", "deadline", "trace", "_done", "_event", "_sig")
 
-    def __init__(self, rows, deadline: Optional[float], sig: threading.Lock):
+    def __init__(self, rows, deadline: Optional[float], sig: threading.Lock,
+                 trace=None):
         self.rows = rows
         self.result = None  # (batch_scores, batch_preds) shared by the batch
         self.meta = None  # score_fn's optional 3rd return (e.g. model entry)
         self._off = 0
         self.error: Optional[BaseException] = None
         self.t_enq = time.perf_counter()
+        self.t_done = None  # set by the worker at completion: the caller
+        # measures its wake-up gap (completion -> get() return) from it
         self.deadline = deadline  # perf_counter timestamp or None
+        # sampled request-trace ctx (obs/trace.py) or None: the worker
+        # records the queue-wait hop and copies the batch's sub-hops
+        # (scorer assemble/execute, front forward) onto it
+        self.trace = trace
         self._done = False
         self._event: Optional[threading.Event] = None
         self._sig = sig  # shared per-batcher signal lock (lost-wake guard)
@@ -122,9 +130,14 @@ class MicroBatcher:
         score_fn: Callable,
         policy: Optional[BatchPolicy] = None,
         controller=None,
+        trace_site: str = "serve",
     ):
         self.score_fn = score_fn
         self.policy = policy or BatchPolicy()
+        # hop-name prefix for request traces through this batcher:
+        # "serve" inside a replica/solo server, "front" for the fleet
+        # front's per-replica forwarders (queue hop = f"{site}.queue")
+        self.trace_site = trace_site
         # optional AIMD batch-size controller (serve/fleet/aimd.py): when
         # set, it supplies max_batch/max_wait_ms live (snapped to the
         # compiled ladder) and is fed per-request latencies by the worker;
@@ -148,16 +161,22 @@ class MicroBatcher:
         self,
         rows: Sequence[Dict[str, float]],
         deadline_ms: Optional[float] = None,
+        trace=None,
     ) -> _Pending:
         """Enqueue rows; returns a pending handle (.get(timeout) blocks).
-        Raises OverloadError (queue full) or ServeClosed synchronously."""
+        Raises OverloadError (queue full) or ServeClosed synchronously.
+        `trace` is an optional obs.trace ctx; the NOOP ctx is normalized
+        to None here so the worker's per-request check stays one `is not
+        None` on the unsampled path."""
         if deadline_ms is None:
             deadline_ms = self.policy.default_deadline_ms
         deadline = (
             time.perf_counter() + deadline_ms / 1e3 if deadline_ms and deadline_ms > 0
             else None
         )
-        req = _Pending(list(rows), deadline, self._sig)
+        if trace is not None and not trace.ids:
+            trace = None
+        req = _Pending(list(rows), deadline, self._sig, trace=trace)
         with self._not_empty:
             if self._closing:
                 raise ServeClosed("serve batcher is draining")
@@ -229,7 +248,16 @@ class MicroBatcher:
                 break
             now = time.perf_counter()
             live: List[_Pending] = []
+            traced = None
             for req in batch:
+                if req.trace is not None:
+                    # queue-wait hop: enqueue -> dequeue, recorded for the
+                    # expired requests too (the 504's trace must SHOW the
+                    # queue is where its deadline went)
+                    req.trace.hop_at(
+                        self.trace_site + ".queue", req.t_enq, now,
+                        rows=len(req.rows),
+                    )
                 if req.deadline is not None and now > req.deadline:
                     obs_inc("serve.deadline_expired")
                     req.error = DeadlineExceeded(
@@ -239,14 +267,30 @@ class MicroBatcher:
                     req.finish()
                 else:
                     live.append(req)
+                    if req.trace is not None:
+                        if traced is None:
+                            traced = []
+                        traced.append(req.trace)
             if not live:
                 continue
             rows: List[dict] = []
             for req in live:
                 rows.extend(req.rows)
+            if traced:
+                # batch-scoped sub-hops (scorer assemble/execute, front
+                # forward) recorded during score_fn land on every traced
+                # request of this batch; the untraced path never touches
+                # the trace module
+                obs_trace.set_current_batch(traced)
             try:
                 with obs_span("serve.batch", rows=len(rows), requests=len(live)):
                     out = self.score_fn(rows)
+                if traced:
+                    # copy the staged hops BEFORE finish(): the handler
+                    # thread closes the trace the moment its pending
+                    # handle completes
+                    obs_trace.end_current_batch()
+                    traced = None
                 # score_fn returns (scores, preds) or (scores, preds, meta);
                 # meta rides along per batch — the server uses it to report
                 # WHICH model version actually scored these rows (resolving
@@ -263,6 +307,7 @@ class MicroBatcher:
                     req.meta = meta
                     req._off = off
                     off += len(req.rows)
+                    req.t_done = t_done
                     req.finish()
                     if self.controller is not None:
                         # client-visible latency (enqueue -> scored): the
@@ -271,6 +316,8 @@ class MicroBatcher:
                 if self.controller is not None:
                     self.controller.note_batch()
             except Exception as e:  # noqa: BLE001 — fail the requests, not the worker
+                if traced:
+                    obs_trace.end_current_batch()  # partial hops still land
                 obs_inc("serve.batch_errors")
                 obs_event("serve.batch_error", error=type(e).__name__)
                 for req in live:

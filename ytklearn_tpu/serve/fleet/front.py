@@ -50,12 +50,17 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from ...obs import (
+    enabled as obs_enabled,
     event as obs_event,
     gauge as obs_gauge,
     inc as obs_inc,
     snapshot as obs_snapshot,
     span as obs_span,
 )
+from ...obs import health as obs_health
+from ...obs import trace as obs_trace
+from ...obs.core import REGISTRY as OBS_REGISTRY
+from ...obs.heartbeat import start_history_sampler
 from ...resilience import is_transient
 from ..batcher import (
     BatchPolicy,
@@ -165,6 +170,29 @@ def latency_percentiles(vals: List[float]) -> Dict[str, float]:
     }
 
 
+#: samples older than this drop out of the fleet ring union: an IDLE
+#: replica's ring holds its last samples forever, and without windowing
+#: those stale latencies dilute the fleet p99 with minutes-old traffic
+RING_UNION_WINDOW_S = 60.0
+
+
+def window_ring_ms(
+    raw: List, now: float, window_s: float = RING_UNION_WINDOW_S
+) -> List[float]:
+    """Replica `?raw=1` ring samples -> the ms values recent enough for
+    the fleet union. Samples are (wall_ts, ms) pairs since r17; bare ms
+    floats (a pre-r17 replica mid-rolling-upgrade) pass through — no
+    timestamp to window on beats dropping the replica's signal."""
+    out: List[float] = []
+    for v in raw:
+        if isinstance(v, (list, tuple)) and len(v) == 2:
+            if now - float(v[0]) <= window_s:
+                out.append(float(v[1]))
+        elif isinstance(v, (int, float)):
+            out.append(float(v))
+    return out
+
+
 class FleetFront:
     """Owns the replica fleet; predict()/admin()/metrics_payload() are the
     API, start()/stop() the lifecycle, serve_http() the listener."""
@@ -180,6 +208,7 @@ class FleetFront:
         monitor_interval_s: float = 0.25,
         forward_timeout_s: float = 60.0,
         log_dir: Optional[str] = None,
+        slo_ms: Optional[float] = None,
     ):
         if replicas < 1:
             raise ValueError(f"fleet needs >= 1 replica, got {replicas}")
@@ -192,6 +221,16 @@ class FleetFront:
         self.monitor_interval_s = monitor_interval_s
         self.forward_timeout_s = forward_timeout_s
         self.log_dir = log_dir
+        # fleet-level SLO burn-rate sentinel over the front's own client-
+        # visible latency (health.slo_burn, site serve.front); the same
+        # SLO arms the trace tail rule
+        self.slo_ms = slo_ms
+        self.slo_burn = (
+            obs_health.SLOBurnSentinel("serve.front", slo_ms)
+            if slo_ms and slo_ms > 0 else None
+        )
+        if slo_ms and slo_ms > 0:
+            obs_trace.configure_tracing(slo_ms=slo_ms)
         self.handles: Dict[int, ReplicaHandle] = {}
         self._forwarders: Dict[int, MicroBatcher] = {}
         # rows currently inside an HTTP round-trip per replica; updated
@@ -257,7 +296,7 @@ class FleetFront:
             ) from err
         for rid in range(self.n_replicas):
             self._forwarders[rid] = MicroBatcher(
-                self._make_score_fn(rid), self.policy
+                self._make_score_fn(rid), self.policy, trace_site="front"
             )
             with self._inflight_lock:
                 self._inflight[rid] = 0
@@ -265,6 +304,8 @@ class FleetFront:
             target=self._monitor_loop, name="ytk-fleet-monitor", daemon=True
         )
         self._monitor.start()
+        if obs_enabled():
+            start_history_sampler()  # /metrics?history=1 on the front
         obs_gauge("serve.fleet.replicas", self.n_replicas)
         log.info("fleet: %d replica(s) up: %s", self.n_replicas,
                  {rid: h.port for rid, h in sorted(self.handles.items())})
@@ -347,17 +388,30 @@ class FleetFront:
         return body + "}"
 
     def _post_predict(self, rid: int, rows, model: Optional[str] = None,
-                      deadline_ms: Optional[float] = None) -> tuple:
-        """One POST to replica `rid`; raises typed errors for non-200."""
+                      deadline_ms: Optional[float] = None,
+                      trace_ids: Optional[List[str]] = None) -> tuple:
+        """One POST to replica `rid`; raises typed errors for non-200.
+        Trace-context propagation: the sampled trace ids of this batch
+        (explicit `trace_ids` on the direct named-model path, else the
+        forwarder's current batch) ride the X-Ytk-Trace header, so the
+        replica adopts them and one trace id spans front -> replica."""
         h = self.handles[rid]
+        ids = trace_ids or obs_trace.current_batch_ids()
+        headers = {obs_trace.TRACE_HEADER: ",".join(ids)} if ids else None
         with self._inflight_lock:
             self._inflight[rid] = self._inflight.get(rid, 0) + len(rows)
         try:
-            status, body = http_json(
-                "POST", h.port, "/predict",
-                self._encode_rows(rows, model, deadline_ms),
-                timeout=self.forward_timeout_s,
-            )
+            # the HTTP forward hop: for a coalesced batch this lands on
+            # every traced request via the batch staging (no-op when the
+            # batch carries no sampled trace)
+            with obs_trace.batch_hop("front.forward", replica=rid,
+                                     rows=len(rows)):
+                status, body = http_json(
+                    "POST", h.port, "/predict",
+                    self._encode_rows(rows, model, deadline_ms),
+                    timeout=self.forward_timeout_s,
+                    headers=headers,
+                )
         finally:
             with self._inflight_lock:
                 self._inflight[rid] = self._inflight.get(rid, 0) - len(rows)
@@ -406,9 +460,13 @@ class FleetFront:
         return score_fn
 
     def _reroute(self, rows, exclude: int, cause,
-                 model: Optional[str] = None) -> tuple:
+                 model: Optional[str] = None,
+                 trace_ids: Optional[List[str]] = None) -> tuple:
         """Forward `rows` to the least-loaded OTHER ready replica, walking
-        the fleet until one answers. Exhaustion re-raises the cause."""
+        the fleet until one answers. Exhaustion re-raises the cause.
+        `trace_ids` keeps context propagation alive across the reroute —
+        the rerouted request is exactly the one whose trace matters most
+        (on the forwarder path the batch staging supplies them instead)."""
         tried = {exclude}
         while True:
             ready = [r for r in self._ready_ids() if r not in tried]
@@ -422,7 +480,8 @@ class FleetFront:
             rid = min(ready, key=self._load_of)
             tried.add(rid)
             try:
-                out = self._post_predict(rid, rows, model)
+                out = self._post_predict(rid, rows, model,
+                                         trace_ids=trace_ids)
             except Exception as e:
                 if not is_transient(e):
                     raise
@@ -453,17 +512,31 @@ class FleetFront:
 
     # -- the client-facing hot path ---------------------------------------
 
-    def submit(self, rows, deadline_ms: Optional[float] = None):
+    def submit(self, rows, deadline_ms: Optional[float] = None, trace=None):
         """Async half of predict() for the default model: route to the
         least-loaded ready replica's forwarder; returns the pending handle
-        (serve_bench drives a bounded in-flight window through this)."""
+        (serve_bench drives a bounded in-flight window through this).
+        `trace` rides the pending handle into the forwarder (queue-wait
+        hop + batch-scoped forward hop + header propagation)."""
         if self.draining:
             raise ServeClosed("fleet front is draining")
         rid = self._pick_replica()
-        return self._forwarders[rid].submit(rows, deadline_ms=deadline_ms)
+        return self._forwarders[rid].submit(
+            rows, deadline_ms=deadline_ms, trace=trace
+        )
+
+    def _request_done(self, ms: float) -> None:
+        self.latency.record(ms)
+        if self.slo_burn is not None:
+            self.slo_burn.observe(ms)
+
+    def _request_errored(self, status: int) -> None:
+        if self.slo_burn is not None and status in (429, 504):
+            self.slo_burn.observe(violated=True)
 
     def predict(self, rows, model: Optional[str] = None,
-                deadline_ms: Optional[float] = None, timeout: float = 60.0):
+                deadline_ms: Optional[float] = None, timeout: float = 60.0,
+                trace=None):
         """Same contract as ServeApp.predict, plus `replica` in the reply.
         Requests go WHOLE to one replica (never split), which resolves the
         model name — a typo still 404s (KeyError) end to end. Deadlines:
@@ -471,32 +544,82 @@ class FleetFront:
         coalesced path it is enforced at the FRONT's queue (dequeue-time
         504), which in the fleet topology is where queueing happens — each
         replica receives one pre-coalesced batch at a time, so its own
-        queue wait is ~zero."""
+        queue wait is ~zero. `trace` follows the ServeApp.predict
+        contract: the HTTP handler owns begin/finish, direct callers get
+        their own."""
         if self.draining:
             raise ServeClosed("fleet front is draining")
+        own = trace is None
+        ctx = obs_trace.begin() if own else trace
         t0 = time.perf_counter()
-        if model is not None:
-            # named-model requests skip the coalescer (the common CLI
-            # fleet serves one default model): direct, still whole
-            rid = self._pick_replica()
-            try:
-                scores, preds, meta = self._post_predict(
-                    rid, rows, model, deadline_ms
-                )
-            except Exception as e:
-                if not is_transient(e):
-                    raise
-                self._note_sick(rid, e)
-                scores, preds, meta = self._reroute(
-                    rows, exclude=rid, cause=e, model=model
-                )
-        else:
-            pending = self.submit(rows, deadline_ms=deadline_ms)
-            scores, preds = pending.get(timeout)
-            meta = pending.meta or {}
-        self.latency.record((time.perf_counter() - t0) * 1e3)
+        try:
+            if model is not None:
+                # named-model requests skip the coalescer (the common CLI
+                # fleet serves one default model): direct, still whole
+                rid = self._pick_replica()
+                try:
+                    with ctx.hop("front.forward", replica=rid,
+                                 rows=len(rows)):
+                        scores, preds, meta = self._post_predict(
+                            rid, rows, model, deadline_ms,
+                            trace_ids=list(ctx.ids),
+                        )
+                except Exception as e:
+                    if not is_transient(e):
+                        raise
+                    self._note_sick(rid, e)
+                    with ctx.hop("front.forward", rerouted=True,
+                                 rows=len(rows)):
+                        scores, preds, meta = self._reroute(
+                            rows, exclude=rid, cause=e, model=model,
+                            trace_ids=list(ctx.ids),
+                        )
+            else:
+                pending = self.submit(rows, deadline_ms=deadline_ms,
+                                      trace=ctx)
+                scores, preds = pending.get(timeout)
+                if ctx.ids and pending.t_done is not None:
+                    # forwarder completion -> handler resumed: the GIL/
+                    # scheduler wake gap, named so a loaded front's p99
+                    # decomposition accounts for it
+                    ctx.hop_at("front.wake", pending.t_done,
+                               time.perf_counter())
+                meta = pending.meta or {}
+        except OverloadError:
+            self._request_errored(429)
+            if own:
+                obs_trace.finish(ctx, status=429, rows=len(rows),
+                                 latency_ms=(time.perf_counter() - t0) * 1e3)
+            raise
+        except DeadlineExceeded:
+            self._request_errored(504)
+            if own:
+                obs_trace.finish(ctx, status=504, rows=len(rows),
+                                 latency_ms=(time.perf_counter() - t0) * 1e3)
+            raise
+        except ServeClosed:
+            if own:
+                obs_trace.finish(ctx, status=503, rows=len(rows),
+                                 latency_ms=(time.perf_counter() - t0) * 1e3)
+            raise
+        except KeyError:
+            if own:  # unknown model name propagated from the replica
+                obs_trace.finish(ctx, status=404, rows=len(rows),
+                                 latency_ms=(time.perf_counter() - t0) * 1e3)
+            raise
+        except Exception:
+            # reroute exhaustion / non-transient replica error: close an
+            # owned trace as a 500 exemplar instead of leaking it
+            if own:
+                obs_trace.finish(ctx, status=500, rows=len(rows),
+                                 latency_ms=(time.perf_counter() - t0) * 1e3)
+            raise
+        ms = (time.perf_counter() - t0) * 1e3
+        self._request_done(ms)
         obs_inc("serve.front.requests")
         obs_inc("serve.front.request_rows", len(rows))
+        if own:
+            obs_trace.finish(ctx, status=200, latency_ms=ms, rows=len(rows))
         out = {
             "model": meta.get("model"),
             "version": meta.get("version"),
@@ -679,9 +802,10 @@ class FleetFront:
             }
         return info
 
-    def metrics_payload(self) -> dict:
+    def metrics_payload(self, history: bool = False) -> dict:
         per: Dict[str, dict] = {}
         ring_union: List[float] = []
+        now = time.time()
         total_restarts = 0
         # scrape replicas CONCURRENTLY: one wedged replica (still 'ready'
         # until its strikes accumulate) must not stall /metrics for the
@@ -707,10 +831,15 @@ class FleetFront:
                 "state": h.state, "restarts": h.restarts,
                 "scrape_error": "scrape timed out",
             }
-            ring_union.extend(info.pop("raw_ms", None) or [])
+            # WINDOWED union: replica rings carry (ts, ms) pairs; stale
+            # samples (an idle replica's old traffic) stay out of the
+            # fleet percentile instead of diluting it
+            ring_union.extend(
+                window_ring_ms(info.pop("raw_ms", None) or [], now)
+            )
             per[str(rid)] = info
         snap = obs_snapshot()
-        return {
+        out = {
             "fleet": {
                 "replicas": len(self.handles),
                 "ready": len(self._ready_ids()),
@@ -729,6 +858,58 @@ class FleetFront:
             "gauges": {
                 k: round(v, 4) for k, v in sorted(snap["gauges"].items())
             },
+        }
+        if history:
+            # the FRONT's metric history (client-visible serve.front.*
+            # series); per-replica history lives at each replica's own
+            # /metrics?history=1
+            out["history"] = OBS_REGISTRY.history_snapshot() or {}
+        return out
+
+    def traces_payload(self) -> dict:
+        """Fleet-wide /admin/traces: the front's own exemplar ring plus
+        every ready replica's, one document. Each per-process payload
+        carries its `wall_t0` clock origin (the spawn-time banner
+        handshake backs it up on the handle, surviving a dead replica),
+        so obs_report can merge all the rings onto one aligned
+        timeline."""
+        handles = sorted(self.handles.items())
+        results: Dict[int, dict] = {}
+
+        def _scrape(rid, h):
+            try:
+                status, body = http_json(
+                    "GET", h.port, "/admin/traces", timeout=2.0
+                )
+                results[rid] = (
+                    body if status == 200 and isinstance(body, dict)
+                    else {"scrape_error": f"HTTP {status}"}
+                )
+            except OSError as e:
+                results[rid] = {
+                    "scrape_error": f"{type(e).__name__}: {e}"[:120]
+                }
+
+        scrapers = [
+            threading.Thread(target=_scrape, args=(rid, h), daemon=True)
+            for rid, h in handles if h.state == "ready"
+        ]
+        for t in scrapers:
+            t.start()
+        for t in scrapers:
+            t.join(timeout=5.0)
+        replicas: Dict[str, dict] = {}
+        for rid, h in handles:
+            info = results.get(rid) or {"scrape_error": f"state={h.state}"}
+            if h.wall_t0 is not None:
+                info.setdefault("wall_t0", h.wall_t0)
+            replicas[str(rid)] = info
+        return {
+            "schema": "ytk_traces",
+            "schema_version": 1,
+            "fleet": True,
+            "front": obs_trace.exemplars_payload(),
+            "replicas": replicas,
         }
 
     # -- HTTP listener ----------------------------------------------------
@@ -751,7 +932,9 @@ class FleetFront:
                 self.wfile.write(body)
 
             def do_GET(self):  # noqa: N802 — stdlib handler API
-                path = urllib.parse.urlsplit(self.path).path
+                split = urllib.parse.urlsplit(self.path)
+                path = split.path
+                query = urllib.parse.parse_qs(split.query)
                 if path == "/healthz":
                     self._json(200, front.health_payload())
                 elif path == "/readyz":
@@ -761,7 +944,10 @@ class FleetFront:
                                 "status": "draining" if front.draining
                                 else ("ok" if ok else "no ready replica")})
                 elif path == "/metrics":
-                    self._json(200, front.metrics_payload())
+                    hist = query.get("history", ["0"])[0] not in ("0", "")
+                    self._json(200, front.metrics_payload(history=hist))
+                elif path == "/admin/traces":
+                    self._json(200, front.traces_payload())
                 else:
                     self._json(404, {"error": f"unknown path {self.path}"})
 
@@ -789,6 +975,8 @@ class FleetFront:
                     return
                 req: dict = {}
                 rows = None
+                t_parse = time.perf_counter()
+                raw_spliced = False
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     raw = self.rfile.read(n)
@@ -801,6 +989,7 @@ class FleetFront:
                         # ride straight into the forward bodies — no
                         # dict round-trip on the front's GIL
                         rows = frags
+                        raw_spliced = True
                         obs_inc("serve.front.raw_splice")
                         obs_inc("serve.front.raw_splice_rows", len(frags))
                     else:
@@ -820,32 +1009,50 @@ class FleetFront:
                 except (ValueError, json.JSONDecodeError) as e:
                     self._json(400, {"error": str(e), "type": "bad_request"})
                     return
+                # request trace: a client-supplied X-Ytk-Trace id is
+                # adopted (forced trace), else the head sampler decides;
+                # the parse hop names whether the body rode raw-splice
+                ctx = obs_trace.begin(
+                    self.headers.get(obs_trace.TRACE_HEADER)
+                )
+                ctx.hop_at("front.parse", t_parse, time.perf_counter(),
+                           rows=len(rows), raw_splice=raw_spliced)
+
+                def _reply(status: int, payload: dict) -> None:
+                    with ctx.hop("front.write", status=status):
+                        self._json(status, payload)
+                    obs_trace.finish(
+                        ctx, status=status, rows=len(rows),
+                        latency_ms=(time.perf_counter() - t_parse) * 1e3,
+                    )
+
                 with obs_span("serve.front.request", rows=len(rows)):
                     try:
                         out = front.predict(
                             rows, model=req.get("model"),
                             deadline_ms=req.get("deadline_ms"),
+                            trace=ctx,
                         )
                     except OverloadError as e:
-                        self._json(429, {"error": str(e), "type": "overload"})
+                        _reply(429, {"error": str(e), "type": "overload"})
                         return
                     except DeadlineExceeded as e:
-                        self._json(504, {"error": str(e), "type": "deadline"})
+                        _reply(504, {"error": str(e), "type": "deadline"})
                         return
                     except ServeClosed as e:
-                        self._json(503, {"error": str(e), "type": "draining"})
+                        _reply(503, {"error": str(e), "type": "draining"})
                         return
                     except KeyError as e:
-                        self._json(404, {"error": str(e.args[0]),
-                                         "type": "unknown_model"})
+                        _reply(404, {"error": str(e.args[0]),
+                                     "type": "unknown_model"})
                         return
                     except Exception as e:  # noqa: BLE001 — typed 500
                         obs_inc("serve.front.request_errors")
                         log.exception("front predict failed")
-                        self._json(500, {"error": f"{type(e).__name__}: {e}",
-                                         "type": "internal"})
+                        _reply(500, {"error": f"{type(e).__name__}: {e}",
+                                     "type": "internal"})
                         return
-                self._json(200, out)
+                _reply(200, out)
 
         self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
         self.port = self._httpd.server_address[1]

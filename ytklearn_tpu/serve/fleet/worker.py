@@ -46,7 +46,7 @@ class ReplicaHandle:
     """One live (or restarting) replica slot owned by the front."""
 
     __slots__ = ("replica_id", "proc", "port", "state", "restarts",
-                 "started_at", "log_path")
+                 "started_at", "log_path", "wall_t0")
 
     def __init__(self, replica_id: int):
         self.replica_id = replica_id
@@ -57,6 +57,10 @@ class ReplicaHandle:
         self.restarts = 0
         self.started_at = 0.0
         self.log_path: Optional[str] = None
+        #: the replica's obs clock origin on the wall clock (banner
+        #: handshake, stamped at every spawn): trace-hop offsets from this
+        #: replica align to the front's timeline as `wall_t0 + ts`
+        self.wall_t0: Optional[float] = None
 
     @property
     def pid(self) -> Optional[int]:
@@ -72,12 +76,14 @@ def http_json(
     path: str,
     payload=None,
     timeout: float = 10.0,
+    headers: Optional[Dict[str, str]] = None,
 ):
     """One HTTP round-trip to a local replica -> (status, parsed body).
     `payload` may be a dict (JSON-encoded here) or pre-built str/bytes
-    (the front's raw-splice forward path skips a re-encode).
-    Connection-level failures raise (OSError shapes — the retry/reroute
-    classification in front.py keys off that)."""
+    (the front's raw-splice forward path skips a re-encode). `headers`
+    merge over the defaults (the trace-context propagation header rides
+    here). Connection-level failures raise (OSError shapes — the
+    retry/reroute classification in front.py keys off that)."""
     conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
     try:
         if payload is None:
@@ -88,11 +94,11 @@ def http_json(
             body = payload.encode()
         else:
             body = json.dumps(payload).encode()
+        hdrs = {"Content-Type": "application/json"} if body else {}
+        if headers:
+            hdrs.update(headers)
         try:
-            conn.request(
-                method, path, body=body,
-                headers={"Content-Type": "application/json"} if body else {},
-            )
+            conn.request(method, path, body=body, headers=hdrs)
             resp = conn.getresponse()
             raw = resp.read()
         except http.client.HTTPException as e:
@@ -220,6 +226,11 @@ def spawn_replica(
         h.port = port
         h.state = "ready"
         h.started_at = time.time()
+        # monotonic-offset handshake: the worker banner carries its obs
+        # clock origin (wall_t0); the front keeps it per slot so a trace
+        # merge can align replica hop offsets without re-asking a process
+        # that may be dead by postmortem time
+        h.wall_t0 = banner.get("wall_t0")
         log.info(
             "fleet: replica %d ready (pid=%d port=%d)",
             replica_id, proc.pid, port,

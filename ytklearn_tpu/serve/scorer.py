@@ -42,6 +42,7 @@ import numpy as np
 from ..config import knobs
 from ..obs import health as obs_health
 from ..obs import event as obs_event, inc as obs_inc, span as obs_span
+from ..obs import trace as obs_trace
 from ..predict.base import OnlinePredictor, numpy_activation
 from ..predict.continuous import (
     FFMPredictor,
@@ -299,7 +300,11 @@ class CompiledScorer:
         return jax.device_get(self._jit(jnp.asarray(chunk)))
 
     def _run(self, rows) -> Tuple[np.ndarray, np.ndarray]:
-        X = self.featurize(rows)
+        # batch assembly hop: request dicts -> dense matrix. batch_hop is
+        # the cached no-op unless the surrounding micro-batch carries a
+        # sampled request trace (obs/trace.py)
+        with obs_trace.batch_hop("serve.assemble", rows=len(rows)):
+            X = self.featurize(rows)
         B = X.shape[0]
         max_rung = self.ladder[-1]
         out_s: List[np.ndarray] = []
@@ -315,7 +320,14 @@ class CompiledScorer:
                     [chunk, np.full((pad, self.dim), self._fill, np.float64)]
                 )
             with obs_span("serve.score", rung=rung, rows=rung - pad):
-                s, p = self._exec(chunk)
+                # ladder-rung execution hop, tagged with the EFFECTIVE
+                # rung (mode/backend from rung_info — a downgraded fused
+                # rung shows up as stacked in the trace, honestly)
+                with obs_trace.batch_hop(
+                    "serve.execute", rung=rung, mode=self.mode,
+                    backend=self.backend,
+                ):
+                    s, p = self._exec(chunk)
             obs_inc("serve.scorer.batches")
             obs_inc("serve.scorer.rows", rung - pad)
             obs_inc("serve.scorer.pad_rows", pad)

@@ -16,7 +16,12 @@ Endpoints (JSON in/out):
   GET /healthz     process liveness + health.* sentinel counter summary
   GET /readyz      200 only when models are loaded+warm and not draining
   GET /metrics     obs registry snapshot + request latency p50/p99/p999,
-                   queue depth, per-model versions
+                   queue depth, per-model versions; `?raw=1` adds the
+                   (ts, ms) latency-ring samples (fleet union input),
+                   `?history=1` adds the per-metric time-series rings
+  GET /admin/traces  the request-trace exemplar ring: head-sampled +
+                   tail-retained (shed/504/SLO-violating) per-hop traces
+                   (obs/trace.py, YTK_TRACE_SAMPLE)
   POST /admin/rollback {"model": name}  swap back to the previously served
                    version and pin (undo a bad continual promotion)
   POST /admin/pin  {"model": name}  freeze the served version (watcher
@@ -42,7 +47,11 @@ from typing import Dict, Optional
 
 import numpy as np
 
-from ..obs import inc as obs_inc, snapshot as obs_snapshot, span as obs_span
+from ..obs import enabled as obs_enabled, inc as obs_inc, snapshot as obs_snapshot, span as obs_span
+from ..obs import health as obs_health
+from ..obs import trace as obs_trace
+from ..obs.core import REGISTRY as OBS_REGISTRY
+from ..obs.heartbeat import start_history_sampler
 from ..resilience import chaos_point
 from .batcher import (
     BatchPolicy,
@@ -59,7 +68,12 @@ log = logging.getLogger("ytklearn_tpu.serve")
 
 
 class _LatencyWindow:
-    """Bounded ring of recent request latencies (ms) -> percentiles."""
+    """Bounded ring of recent request latencies -> percentiles.
+
+    Samples are (wall_ts, ms) PAIRS: the export (`/metrics?raw=1`) must
+    carry timestamps so the fleet front can WINDOW the ring union — an
+    idle replica's ring otherwise holds stale samples forever and dilutes
+    the fleet p99 with minutes-old latencies (r17 satellite fix)."""
 
     def __init__(self, maxlen: int = 4096):
         self._ring = collections.deque(maxlen=maxlen)
@@ -67,14 +81,14 @@ class _LatencyWindow:
 
     def record(self, ms: float) -> None:
         with self._lock:
-            self._ring.append(ms)
+            self._ring.append((time.time(), ms))
 
     def raw(self) -> list:
-        """The ring itself (ms floats) — the fleet front unions replica
-        rings so fleet p99 is computed over every replica's samples, not
-        replica-0's (a per-replica percentile cannot be averaged)."""
+        """[(wall_ts, ms)] pairs — the fleet front unions replica rings
+        (windowed on ts) so fleet p99 is computed over every replica's
+        RECENT samples, not replica-0's and not stale ones."""
         with self._lock:
-            return list(self._ring)
+            return [[round(t, 3), round(v, 3)] for t, v in self._ring]
 
     def percentiles(self) -> Dict[str, float]:
         # one percentile implementation serves both the per-process ring
@@ -82,7 +96,7 @@ class _LatencyWindow:
         from .fleet.front import latency_percentiles
 
         with self._lock:
-            vals = list(self._ring)
+            vals = [v for _, v in self._ring]
         return latency_percentiles(vals)
 
 
@@ -111,6 +125,16 @@ class ServeApp:
         # fleet identity: stamped into /metrics so the front (and a
         # postmortem) can name this replica; None = solo process
         self.replica_id = replica_id
+        # SLO burn-rate sentinel (health.slo_burn): every request feeds
+        # it; a windowed violation rate over budget fires the alarm. The
+        # same SLO arms the trace plane's tail rule (SLO-violating
+        # requests are always kept as exemplars)
+        self.slo_burn = (
+            obs_health.SLOBurnSentinel("serve.predict", slo_ms)
+            if slo_ms and slo_ms > 0 else None
+        )
+        if slo_ms and slo_ms > 0:
+            obs_trace.configure_tracing(slo_ms=slo_ms)
         self.latency = _LatencyWindow()
         self.draining = False
         self._batchers: Dict[str, MicroBatcher] = {}
@@ -144,9 +168,27 @@ class ServeApp:
                 self._batchers[name] = b
             return b
 
+    def _request_done(self, ms: float) -> None:
+        """Per-request bookkeeping shared by every completion path."""
+        self.latency.record(ms)
+        if self.slo_burn is not None:
+            self.slo_burn.observe(ms)
+
+    def _request_errored(self, status: int) -> None:
+        """429/504 burned SLO budget without ever being scored; a 503
+        drain is the server going away, not a burn."""
+        if self.slo_burn is not None and status in (429, 504):
+            self.slo_burn.observe(violated=True)
+
     def predict(self, rows, model: Optional[str] = None,
-                deadline_ms: Optional[float] = None, timeout: float = 30.0):
-        """The serving hot path (HTTP handler and tests both land here)."""
+                deadline_ms: Optional[float] = None, timeout: float = 30.0,
+                trace=None):
+        """The serving hot path (HTTP handler and tests both land here).
+
+        `trace` is an obs.trace ctx the HTTP handler began (it owns the
+        finish — the response write is part of the trace); direct callers
+        leave it None and this method begins/finishes its own, so a bench
+        or embedded caller gets the same exemplars the HTTP path does."""
         if self.draining:
             raise ServeClosed("server is draining")
         names = self.registry.names()
@@ -157,28 +199,69 @@ class ServeApp:
         # fleet restart drill: kind=kill here takes this replica down
         # mid-request, exactly like a hardware loss under load
         chaos_point("serve.worker")
+        own = trace is None
+        ctx = obs_trace.begin() if own else trace
         t0 = time.perf_counter()
-        cache = self.cache
-        if cache is not None:
-            hit = cache.lookup(cache.model_key(entry), rows)
-            if hit is not None:
-                # every row of this request was scored before by the
-                # CURRENT entry: bypass the queue entirely (no batcher,
-                # no scorer) — the stored values ARE the scored path's
-                # outputs, so the response is bit-identical to a cold one
-                self.latency.record((time.perf_counter() - t0) * 1e3)
-                obs_inc("serve.requests")
-                obs_inc("serve.request_rows", len(rows))
-                return {
-                    "model": name,
-                    "version": entry.version,
-                    "cached": True,
-                    "scores": np.asarray([h[0] for h in hit]).tolist(),
-                    "predictions": np.asarray([h[1] for h in hit]).tolist(),
-                }
-        pending = self.batcher_for(name).submit(rows, deadline_ms=deadline_ms)
-        scores, preds = pending.get(timeout)
-        self.latency.record((time.perf_counter() - t0) * 1e3)
+        try:
+            cache = self.cache
+            if cache is not None:
+                hit = cache.lookup(cache.model_key(entry), rows)
+                ctx.hop_at("serve.cache", t0, time.perf_counter(),
+                           hit=hit is not None, rows=len(rows))
+                if hit is not None:
+                    # every row of this request was scored before by the
+                    # CURRENT entry: bypass the queue entirely (no batcher,
+                    # no scorer) — the stored values ARE the scored path's
+                    # outputs, so the response is bit-identical to a cold one
+                    ms = (time.perf_counter() - t0) * 1e3
+                    self._request_done(ms)
+                    obs_inc("serve.requests")
+                    obs_inc("serve.request_rows", len(rows))
+                    if own:
+                        obs_trace.finish(ctx, status=200, latency_ms=ms,
+                                         rows=len(rows), cached=True)
+                    return {
+                        "model": name,
+                        "version": entry.version,
+                        "cached": True,
+                        "scores": np.asarray([h[0] for h in hit]).tolist(),
+                        "predictions": np.asarray([h[1] for h in hit]).tolist(),
+                    }
+            pending = self.batcher_for(name).submit(
+                rows, deadline_ms=deadline_ms, trace=ctx
+            )
+            scores, preds = pending.get(timeout)
+            if ctx.ids and pending.t_done is not None:
+                # completion -> this thread resumed: GIL/scheduler wake
+                # latency, a real stage of the request under load
+                ctx.hop_at("serve.wake", pending.t_done, time.perf_counter())
+        except OverloadError:
+            self._request_errored(429)
+            if own:
+                obs_trace.finish(ctx, status=429, rows=len(rows),
+                                 latency_ms=(time.perf_counter() - t0) * 1e3)
+            raise
+        except DeadlineExceeded:
+            self._request_errored(504)
+            if own:
+                obs_trace.finish(ctx, status=504, rows=len(rows),
+                                 latency_ms=(time.perf_counter() - t0) * 1e3)
+            raise
+        except ServeClosed:
+            if own:  # a drain is not an SLO burn, but the trace closes
+                obs_trace.finish(ctx, status=503, rows=len(rows),
+                                 latency_ms=(time.perf_counter() - t0) * 1e3)
+            raise
+        except Exception:
+            # batch error, timeout, anything else: an owned head-sampled
+            # trace must still land in the ring (status 500) instead of
+            # leaking with its hops unrecorded
+            if own:
+                obs_trace.finish(ctx, status=500, rows=len(rows),
+                                 latency_ms=(time.perf_counter() - t0) * 1e3)
+            raise
+        ms = (time.perf_counter() - t0) * 1e3
+        self._request_done(ms)
         obs_inc("serve.requests")
         obs_inc("serve.request_rows", len(rows))
         # version from the batch's own entry resolution — the response
@@ -189,6 +272,8 @@ class ServeApp:
             # keyed by the entry that ACTUALLY scored the batch: a swap
             # landing between submit and score must not mislabel rows
             cache.store(cache.model_key(entry), rows, scores, preds)
+        if own:
+            obs_trace.finish(ctx, status=200, latency_ms=ms, rows=len(rows))
         return {
             "model": name,
             "version": entry.version,
@@ -222,15 +307,16 @@ class ServeApp:
             },
         }
 
-    def metrics_payload(self, raw: bool = False) -> dict:
+    def metrics_payload(self, raw: bool = False, history: bool = False) -> dict:
         snap = obs_snapshot()
         with self._batchers_lock:  # batcher_for inserts concurrently
             batchers = dict(self._batchers)
         latency = self.latency.percentiles()
         if raw:
-            # the fleet front merges replica rings (union, then one
-            # percentile pass) — fleet p99 must be a fleet number
-            latency["raw_ms"] = [round(v, 3) for v in self.latency.raw()]
+            # the fleet front merges replica rings (union windowed on the
+            # sample timestamps, then one percentile pass) — fleet p99
+            # must be a fleet number computed over RECENT samples
+            latency["raw_ms"] = self.latency.raw()
         out = {
             # identity rides every metrics scrape so the front's fleet
             # table (and a postmortem diffing scrapes) names the replica
@@ -263,6 +349,11 @@ class ServeApp:
         if self.cache is not None:
             out["cache"] = {"rows": len(self.cache),
                             "max_rows": self.cache.max_rows}
+        if history:
+            # metrics history plane: bounded per-metric (ts, value) rings
+            # sampled by the obs heartbeat thread (YTK_OBS_HISTORY_N) —
+            # {} when the plane is off (obs disabled or N=0)
+            out["history"] = OBS_REGISTRY.history_snapshot() or {}
         return out
 
     # -- lifecycle --------------------------------------------------------
@@ -332,7 +423,13 @@ class ServeApp:
                                 ("ok" if ok else "no models")})
                 elif path == "/metrics":
                     raw = query.get("raw", ["0"])[0] not in ("0", "")
-                    self._json(200, app.metrics_payload(raw=raw))
+                    hist = query.get("history", ["0"])[0] not in ("0", "")
+                    self._json(200, app.metrics_payload(raw=raw, history=hist))
+                elif path == "/admin/traces":
+                    # the per-process exemplar ring: head-sampled + tail-
+                    # retained request traces (obs/trace.py); obs_report
+                    # merges these cross-process into one waterfall
+                    self._json(200, obs_trace.exemplars_payload())
                 else:
                     self._json(404, {"error": f"unknown path {self.path}"})
 
@@ -344,6 +441,7 @@ class ServeApp:
                 if self.path != "/predict":
                     self._json(404, {"error": f"unknown path {self.path}"})
                     return
+                t_parse = time.perf_counter()
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     req = json.loads(self.rfile.read(n) or b"{}")
@@ -361,33 +459,52 @@ class ServeApp:
                 except (ValueError, json.JSONDecodeError) as e:
                     self._json(400, {"error": str(e), "type": "bad_request"})
                     return
+                # request trace: adopt the front's propagated ids (the
+                # X-Ytk-Trace header a forwarded batch carries), else let
+                # the head sampler decide; the handler owns begin+finish
+                # so parse and response write are part of the trace
+                ctx = obs_trace.begin(
+                    self.headers.get(obs_trace.TRACE_HEADER)
+                )
+                ctx.hop_at("serve.parse", t_parse, time.perf_counter(),
+                           rows=len(rows))
+
+                def _reply(status: int, payload: dict) -> None:
+                    with ctx.hop("serve.write", status=status):
+                        self._json(status, payload)
+                    obs_trace.finish(
+                        ctx, status=status, rows=len(rows),
+                        latency_ms=(time.perf_counter() - t_parse) * 1e3,
+                    )
+
                 with obs_span("serve.request", rows=len(rows)):
                     try:
                         out = app.predict(
                             rows,
                             model=req.get("model"),
                             deadline_ms=req.get("deadline_ms"),
+                            trace=ctx,
                         )
                     except OverloadError as e:
-                        self._json(429, {"error": str(e), "type": "overload"})
+                        _reply(429, {"error": str(e), "type": "overload"})
                         return
                     except DeadlineExceeded as e:
-                        self._json(504, {"error": str(e), "type": "deadline"})
+                        _reply(504, {"error": str(e), "type": "deadline"})
                         return
                     except ServeClosed as e:
-                        self._json(503, {"error": str(e), "type": "draining"})
+                        _reply(503, {"error": str(e), "type": "draining"})
                         return
                     except KeyError as e:
-                        self._json(404, {"error": str(e.args[0]),
-                                         "type": "unknown_model"})
+                        _reply(404, {"error": str(e.args[0]),
+                                     "type": "unknown_model"})
                         return
                     except Exception as e:  # noqa: BLE001 — typed 500
                         obs_inc("serve.request_errors")
                         log.exception("predict failed")
-                        self._json(500, {"error": f"{type(e).__name__}: {e}",
-                                         "type": "internal"})
+                        _reply(500, {"error": f"{type(e).__name__}: {e}",
+                                     "type": "internal"})
                         return
-                self._json(200, out)
+                _reply(200, out)
 
         self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
         self.port = self._httpd.server_address[1]
@@ -396,6 +513,11 @@ class ServeApp:
             kwargs={"poll_interval": 0.1}, daemon=True,
         )
         self._serve_thread.start()
+        if obs_enabled():
+            # metrics history plane: per-metric rings sampled by the obs
+            # heartbeat thread; /metrics?history=1 exports them (no-op
+            # when YTK_OBS_HISTORY_N=0)
+            start_history_sampler()
         log.info("serve: listening on %s:%d (%d model(s))",
                  self.host, self.port, len(self.registry))
         return self
