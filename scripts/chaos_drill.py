@@ -26,9 +26,17 @@ series:
              the sibling — zero client-visible failures), the slot
              restarts, and the flight dump carries the
              serve.worker.{died,restarted} evidence naming the replica
+  autoscale  kill -9 a replica MID-RAMP: an autoscaling fleet (band
+             1..3, p99-over-SLO up signal) is driven into a scale-up,
+             then a ready replica is killed while the ramp is live. The
+             MONITOR must heal the slot (serve.worker.restarted) while
+             the autoscaler DEFERS its decisions (serve.scale.deferred —
+             respawn is capacity arriving, not a scale-up trigger), the
+             slot count must never exceed --replicas-max (no
+             double-spawn), and zero in-flight requests may fail
 
 Usage:
-    python scripts/chaos_drill.py [--out CHAOS_r14.json] [--keep]
+    python scripts/chaos_drill.py [--out CHAOS_r18.json] [--keep]
 
 Exits non-zero when any step fails; the artifact is written either way
 (a failing drill should leave evidence, not vanish).
@@ -136,7 +144,7 @@ def _flight_evidence(doc) -> dict:
 
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
-    ap.add_argument("--out", default="CHAOS_r14.json")
+    ap.add_argument("--out", default="CHAOS_r18.json")
     ap.add_argument("--keep", action="store_true",
                     help="keep the scratch dir for inspection")
     args = ap.parse_args()
@@ -383,6 +391,166 @@ def main() -> int:
     check(step["restart_event_replica"] == 0,
           "fleet kill: restart event does not name replica 0")
     record["steps"]["fleet_kill"] = step
+
+    # 8. autoscale: kill -9 a replica MID-RAMP ----------------------------
+    # (the heal/autoscale interplay: the monitor owns the dead slot —
+    # respawn counts as capacity arriving, the autoscaler defers, and
+    # the slot count never exceeds the --replicas-max bound)
+    import collections
+
+    from ytklearn_tpu.serve.batcher import OverloadError
+
+    recorder.install(flight_dir=os.path.join(work, "flight"))
+    counters0 = obs.snapshot()["counters"]
+    REPLICAS_MAX = 3
+    front = FleetFront(
+        serve_worker_argv(
+            _conf(work, "base", 2), "gbdt",
+            ["--watch-interval", "0", "--max-queue", "16384"],
+        ),
+        1,
+        policy=BatchPolicy(max_batch=256, max_wait_ms=0.5, max_queue=16384),
+        ready_timeout_s=600.0,
+        monitor_interval_s=0.1,
+        log_dir=os.path.join(work, "fleet_logs"),
+        # a tight SLO makes the saturated front's p99 the up signal (the
+        # drill model is tiny — backlog alone would never accumulate)
+        slo_ms=15.0,
+        replicas_min=1,
+        replicas_max=REPLICAS_MAX,
+        autoscale={"interval_s": 0.3, "up_backlog": 64.0,
+                   "down_backlog": 4.0, "up_windows": 2,
+                   "down_windows": 1 << 20, "up_cooldown_s": 1.0,
+                   "down_cooldown_s": 60.0},
+    ).start()
+    errors, completed, sheds = [], [0], [0]
+    max_slots_seen = [len(front.handles)]
+    stop_evt = threading.Event()
+    watch_stop = threading.Event()
+
+    def slot_watch() -> None:
+        # the no-double-spawn witness: sample the slot count the whole
+        # drill — one instant past REPLICAS_MAX is the failure
+        while not watch_stop.wait(0.05):
+            n = len(front.handles)
+            if n > max_slots_seen[0]:
+                max_slots_seen[0] = n
+
+    def pump() -> None:
+        import numpy as np
+
+        r = np.random.RandomState(0)
+        rows = [{f"c{j}": float(v) for j, v in enumerate(r.randn(8))}
+                for _ in range(256)]
+        inflight = collections.deque()
+        i = 0
+        while not stop_evt.is_set() or inflight:
+            if not stop_evt.is_set() and len(inflight) < 1500:
+                try:
+                    inflight.append(front.submit([rows[i % len(rows)]]))
+                    i += 1
+                    continue
+                except OverloadError:
+                    sheds[0] += 1
+                    stop_evt.wait(0.002)
+                    continue
+                except Exception as e:  # noqa: BLE001 — every failure is a finding
+                    errors.append(f"submit {type(e).__name__}: {e}"[:200])
+                    stop_evt.wait(0.01)
+                    continue
+            if inflight:
+                p = inflight.popleft()
+                try:
+                    p.get(timeout=120.0)
+                    completed[0] += 1
+                except Exception as e:  # noqa: BLE001 — every failure is a finding
+                    errors.append(f"{type(e).__name__}: {e}"[:200])
+
+    watcher = threading.Thread(target=slot_watch, daemon=True)
+    pumper = threading.Thread(target=pump)
+    victim_rid = victim_pid = None
+    try:
+        watcher.start()
+        pumper.start()
+        # wait for the ramp to be provably in progress (a scale-up landed)
+        deadline = time.time() + 300.0
+        while time.time() < deadline and len(front._ready_ids()) < 2:
+            time.sleep(0.05)
+        ramped = len(front._ready_ids()) >= 2
+        # kill a READY replica mid-ramp
+        victim_rid = sorted(front._ready_ids())[0]
+        victim = front.handles[victim_rid]
+        victim_pid = victim.pid
+        os.kill(victim_pid, _signal.SIGKILL)
+        deadline = time.time() + 300.0
+        while time.time() < deadline and not (
+            victim.restarts >= 1 and victim.state == "ready"
+        ):
+            time.sleep(0.05)
+        healed = victim.restarts >= 1 and victim.state == "ready"
+        time.sleep(1.0)  # load over the healed slot, more defer/up ticks
+    finally:
+        stop_evt.set()
+        pumper.join(timeout=120.0)
+        watch_stop.set()
+        watcher.join(timeout=10.0)
+    snap = obs.snapshot()["counters"]
+    autoscale_snap = (front.autoscaler.snapshot()
+                      if front.autoscaler is not None else {})
+    dump_path = recorder.dump("autoscale_drill")
+    flight_doc = None
+    if dump_path:
+        with open(dump_path) as f:
+            flight_doc = json.load(f)
+    ring_names = sorted({
+        e.get("name", "")
+        for e in ((flight_doc or {}).get("flight") or {}).get("ring", [])
+    })
+
+    def delta(key: str) -> float:
+        return snap.get(key, 0.0) - counters0.get(key, 0.0)
+
+    step = {
+        "requests_completed": completed[0],
+        "request_failures": len(errors),
+        "failure_samples": errors[:3],
+        "shed_429": sheds[0],
+        "victim_replica": victim_rid,
+        "victim_pid": victim_pid,
+        "replicas_max": REPLICAS_MAX,
+        "max_slots_seen": max_slots_seen[0],
+        "ready_at_end": len(front._ready_ids()),
+        "scale_up": delta("serve.scale.up"),
+        "scale_deferred": delta("serve.scale.deferred"),
+        "scale_blocked": delta("serve.scale.blocked"),
+        "worker_died": delta("serve.worker.died"),
+        "worker_restarted": delta("serve.worker.restarted"),
+        "autoscale_state": autoscale_snap,
+        "flight_dump": os.path.basename(dump_path) if dump_path else None,
+        "flight_ring_events": [n for n in ring_names
+                               if n.startswith("serve.")],
+    }
+    front.stop(drain=True, timeout=60.0)
+    recorder.uninstall()
+    check(ramped, "autoscale: fleet never ramped past 1 replica under load")
+    check(len(errors) == 0,
+          f"autoscale kill: {len(errors)} in-flight request failure(s): "
+          f"{errors[:3]}")
+    check(completed[0] > 100, "autoscale: almost no traffic completed")
+    check(healed, "autoscale: monitor did not heal the killed replica")
+    check(step["worker_died"] >= 1, "autoscale: no serve.worker.died")
+    check(step["worker_restarted"] >= 1,
+          "autoscale: no serve.worker.restarted (heal is the monitor's job)")
+    check(step["scale_up"] >= 1, "autoscale: no serve.scale.up decision")
+    check(step["scale_deferred"] >= 1,
+          "autoscale: no serve.scale.deferred while the respawn was in "
+          "flight")
+    check(step["max_slots_seen"] <= REPLICAS_MAX,
+          f"autoscale: slot count hit {step['max_slots_seen']} — the "
+          f"autoscaler double-spawned past --replicas-max={REPLICAS_MAX}")
+    check("serve.scale.up" in step["flight_ring_events"],
+          "autoscale: flight dump missing serve.scale.up event")
+    record["steps"]["autoscale_kill_mid_ramp"] = step
 
     record["problems"] = problems
     with open(args.out + ".tmp", "w") as f:

@@ -44,6 +44,14 @@ not a regression signal), on req/s floor, p99 ceiling, and zero
 fleet-wide retraces. Single-process serve artifacts skip fleet records
 cleanly (and vice versa), so the schema bump never breaks the gate.
 
+Ramp gate: SCALE_r*.json artifacts (`serve_bench.py --ramp`, schema
+"serve_scale") carry the autoscaler elasticity story. The NEWEST one is
+re-gated absolutely (zero request failures, shrink back to the floor,
+sheds confined to the pre-scale window), and when a predecessor with the
+same (metric, replicas_min, replicas_max) band exists, the peak replica
+count reached under the same ramp must not regress. Skips cleanly when
+no serve_scale artifact exists.
+
 GOSS gate: the newest ABLATION_r*.json holding both a `goss` arm and a
 both-off baseline arm (`part`, else `b256`/`nopart`) is checked WITHIN
 the artifact — the headline ships with GOSS on, so a previous-BENCH
@@ -479,6 +487,121 @@ def check_serve(old, new, tol: float) -> List[str]:
 
 
 # ---------------------------------------------------------------------------
+# Autoscaler ramp gate (SCALE_r*.json, serve_bench.py --ramp)
+# ---------------------------------------------------------------------------
+
+
+def find_scale_artifacts(repo: str) -> List[Tuple[int, str]]:
+    """[(round, path)] sorted by round number (SCALE_r<NN>.json)."""
+    out = []
+    for path in glob.glob(os.path.join(repo, "SCALE_*.json")):
+        m = re.search(r"SCALE_r?(\d+)\.json$", os.path.basename(path))
+        if m:
+            out.append((int(m.group(1)), path))
+    return sorted(out)
+
+
+def read_scale_record(path: str):
+    """Normalized serve_scale ramp record (raw or CI-driver-wrapped), or
+    None for anything else."""
+    import json
+
+    with open(path) as f:
+        rec = json.load(f)
+    if "parsed" in rec and "cmd" in rec:  # CI driver wrapper
+        rec = rec["parsed"] or {}
+    if rec.get("schema") != "serve_scale":
+        return None
+    return rec
+
+
+def scale_comparable_pair(artifacts: List[Tuple[int, str]]):
+    """Newest two ramp records sharing (metric, replicas_min,
+    replicas_max) — a 1->4 ramp is a different workload than a 2->8 one,
+    exactly like the fleet gate's same-replica-count rule."""
+    usable = []
+    for rnd, path in artifacts:
+        try:
+            rec = read_scale_record(path)
+        except Exception as e:  # noqa: BLE001 — a rotten artifact is a skip
+            print(f"  [skip] {os.path.basename(path)}: unreadable ({e})")
+            continue
+        if rec and rec.get("metric") and rec.get("peak_replicas") is not None:
+            usable.append((rnd, path, rec))
+    if len(usable) < 2:
+        return None
+    newest = usable[-1]
+    for older in reversed(usable[:-1]):
+        if (older[2]["metric"] == newest[2]["metric"]
+                and older[2].get("replicas_min") == newest[2].get("replicas_min")
+                and older[2].get("replicas_max") == newest[2].get("replicas_max")):
+            return older, newest
+    return None
+
+
+def check_scale_pair(old, new) -> List[str]:
+    """-> failure messages for the same-(min,max) ramp pair: elasticity
+    must not regress (a fleet that used to reach 4 replicas under the
+    same ramp and now stalls at 2 lost its scale-up path)."""
+    (o_rnd, _o_path, o), (n_rnd, _n_path, n) = old, new
+    fails = []
+    print(
+        f"  ramp peak ({n.get('replicas_min')}->{n.get('replicas_max')}): "
+        f"r{n_rnd} {n['peak_replicas']} vs r{o_rnd} {o['peak_replicas']} "
+        "replicas"
+    )
+    if n["peak_replicas"] < o["peak_replicas"]:
+        fails.append(
+            f"ramp peak regressed: reached {n['peak_replicas']} replica(s) "
+            f"vs {o['peak_replicas']} under the same "
+            f"[{n.get('replicas_min')}, {n.get('replicas_max')}] band"
+        )
+    return fails
+
+
+def check_scale_absolute(artifacts: List[Tuple[int, str]]) -> List[str]:
+    """Absolute gate on the NEWEST ramp artifact: the acceptance facts it
+    recorded must still hold (zero failures, shrink completed, sheds
+    confined to the pre-scale window) — a hand-edited or stale artifact
+    cannot quietly ship a broken elasticity story."""
+    for rnd, path in reversed(artifacts):
+        try:
+            rec = read_scale_record(path)
+        except Exception as e:  # noqa: BLE001 — a rotten artifact is a skip
+            print(f"  [skip] {os.path.basename(path)}: unreadable ({e})")
+            continue
+        if rec is None:
+            continue
+        fails = []
+        name = os.path.basename(path)
+        print(
+            f"  ramp (r{rnd}): peak={rec.get('peak_replicas')} "
+            f"end={rec.get('end_replicas')} failures={rec.get('failures')} "
+            f"sheds={rec.get('shed_429')} "
+            f"(after peak: {rec.get('sheds_after_peak')})"
+        )
+        if rec.get("failures"):
+            fails.append(
+                f"ramp artifact {name} records {rec['failures']} request "
+                "failure(s) — the zero-loss contract is broken"
+            )
+        if rec.get("end_replicas") != rec.get("replicas_min"):
+            fails.append(
+                f"ramp artifact {name} ended at {rec.get('end_replicas')} "
+                f"replica(s), not the {rec.get('replicas_min')} floor"
+            )
+        if rec.get("sheds_after_peak"):
+            fails.append(
+                f"ramp artifact {name} records "
+                f"{rec['sheds_after_peak']} shed(s) after the fleet "
+                "reached its peak (sheds must be pre-scale only)"
+            )
+        return fails
+    print("  ramp: no serve_scale artifact (skip)")
+    return []
+
+
+# ---------------------------------------------------------------------------
 # GOSS ablation gate (within-artifact arm comparison)
 # ---------------------------------------------------------------------------
 
@@ -601,6 +724,18 @@ def main(argv=None) -> int:
               "replicas, rung) fleet pair)")
     else:
         fails += check_fleet(*fleet_pair, tol=args.tol)
+
+    # autoscaler ramp gate: newest SCALE artifact re-gated absolutely,
+    # plus same-(min,max) pair comparison when a predecessor exists
+    scale_artifacts = find_scale_artifacts(args.dir)
+    print(f"check_bench_regress: {len(scale_artifacts)} SCALE artifact(s)")
+    fails += check_scale_absolute(scale_artifacts)
+    scale_pair = scale_comparable_pair(scale_artifacts)
+    if scale_pair is None:
+        print("check_bench_regress: SKIP ramp pair gate (no same-(metric, "
+              "min, max) ramp pair)")
+    else:
+        fails += check_scale_pair(*scale_pair)
 
     # GOSS gate: newest ablation artifact with goss + baseline arms
     ablations = find_ablation_artifacts(args.dir)

@@ -439,6 +439,37 @@ def report(path: str, perfetto: Optional[str] = None) -> None:
         for k in ("auc", "logloss", "trees", "data_source", "quality_band"):
             if k in bench:
                 print(f"  {k}: {bench[k]}")
+        if bench.get("schema") == "serve_scale":
+            _section("autoscaler ramp (serve_bench --ramp)")
+            print(f"  band: [{bench.get('replicas_min')}, "
+                  f"{bench.get('replicas_max')}]  peak: "
+                  f"{bench.get('peak_replicas')}  end: "
+                  f"{bench.get('end_replicas')}  (peak at "
+                  f"t={bench.get('t_peak_s')}s)")
+            print(f"  requests: {bench.get('requests')}  failures: "
+                  f"{bench.get('failures')}  sheds: {bench.get('shed_429')} "
+                  f"in window {bench.get('shed_window_s')} "
+                  f"(after peak: {bench.get('sheds_after_peak')})")
+            print(f"  p99: {bench.get('p99_ms')} ms overall, "
+                  f"{bench.get('p99_at_peak_ms')} ms at peak capacity")
+            for k, v in sorted((bench.get("scale_counters") or {}).items()):
+                print(f"  {k:<28s} {v:g}")
+            # the replica-count ring IS the ramp shape
+            hist = bench.get("history_replicas") or []
+            if hist:
+                print("  serve.fleet.replicas  "
+                      + _sparkline([float(v) for _t, v in hist])
+                      + f" last={hist[-1][1]:g}")
+            for ev in (bench.get("scale_events") or [])[:16]:
+                args_ = ev.get("args") or {}
+                detail = " ".join(
+                    f"{k}={args_[k]}"
+                    for k in ("replica_id", "backlog_rows", "ready", "slots",
+                              "shed", "p99_ms", "streak", "want")
+                    if k in args_
+                )
+                print(f"  event {ev.get('name')} @ {ev.get('ts', 0):.3f}s "
+                      f"{detail}")
         if bench.get("schema") == "serve_fleet":
             _section("fleet scaling (sustained req/s at p99)")
             print(f"  {'replicas':>8s} {'req/s':>10s} {'p50 ms':>9s} "
@@ -500,6 +531,22 @@ def report(path: str, perfetto: Optional[str] = None) -> None:
         _section("serving fleet")
         print(f"  replicas: {fl.get('replicas')} ready: {fl.get('ready')} "
               f"restarts: {fl.get('restarts')}")
+        a = fm.get("autoscale") or {}
+        if a.get("enabled"):
+            last = a.get("last_decision") or {}
+            print(f"  autoscale: band [{a.get('min')}, {a.get('max')}] "
+                  f"interval={a.get('interval_s')}s "
+                  f"streaks up={a.get('up_streak')}/{a.get('up_windows')} "
+                  f"down={a.get('down_streak')}/{a.get('down_windows')} "
+                  f"cooldowns up={a.get('up_cooldown_remaining_s')}s "
+                  f"down={a.get('down_cooldown_remaining_s')}s")
+            if last:
+                print(f"  last decision: {last.get('action')} "
+                      f"(backlog={last.get('backlog_rows')} "
+                      f"shed={last.get('shed')} p99={last.get('p99_ms')}ms "
+                      f"ready={last.get('ready')})")
+        elif a:
+            print(f"  autoscale: off (fixed fleet of {a.get('min')})")
         front_lat = fm.get("latency") or {}
         fleet_lat = fm.get("fleet_latency") or {}
         if front_lat.get("count"):

@@ -72,8 +72,25 @@ Acceptance: headline (max replicas) >= SERVE_FLEET_MIN_X (2.5) x the
 SERVE_r09 single-process baseline, p99 <= SLO, zero fleet retraces,
 mixed scenario completes with sheds > 0 and both model versions seen.
 
+Ramp mode (`--ramp`, ISSUE 14): the load-driven autoscaler scenario —
+one FleetFront booted at 1 replica with `--replicas-max N` (default 4)
+and a fast-tick autoscale policy, driven by a RISING then FALLING
+offered load (bounded in-flight window stepping up, holding, stepping
+down). Records the replica-count timeline, every `serve.scale.*`
+decision event, the shed window, and fleet p99, as one
+`schema: "serve_scale"` JSON line; --record writes SCALE_rNN.json for
+check_bench_regress's ramp gate (same-(min,max) artifacts only).
+
+Acceptance: fleet grows 1 -> >= SCALE_MIN_PEAK (3) under the rising
+load and shrinks back to the floor when it falls; ZERO request
+failures end to end (typed 429 sheds are expected — but confined to
+the pre-scale window: none after the fleet reaches its peak); scale
+decisions visible as serve.scale.* events AND in the
+`/metrics?history=1` serve.fleet.replicas ring.
+
 Usage: python scripts/serve_bench.py [--seconds 2.0] [--record SERVE_rNN.json]
        python scripts/serve_bench.py --fleet --replicas 4 --record SERVE_r14.json
+       python scripts/serve_bench.py --ramp --replicas 4 --record SCALE_r18.json
 """
 
 from __future__ import annotations
@@ -631,7 +648,8 @@ def _write_serve_conf(tmp_dir: str, trees: int) -> str:
 
 
 def _boot_front(conf_path, replicas, slo_ms, cache_rows, watch_s,
-                front_queue):
+                front_queue, replicas_min=None, replicas_max=None,
+                autoscale=None, front_slo_ms=None):
     from ytklearn_tpu.serve import BatchPolicy, FleetFront, serve_worker_argv
 
     flags = [
@@ -647,6 +665,13 @@ def _boot_front(conf_path, replicas, slo_ms, cache_rows, watch_s,
         policy=BatchPolicy(max_batch=512, max_wait_ms=0.5,
                            max_queue=front_queue),
         ready_timeout_s=600.0,
+        # ramp mode arms the FRONT's SLO (burn sentinel + the policy's
+        # p99-vs-SLO up signal); the fleet matrix keeps its r14 shape
+        # (workers get --slo-ms for AIMD either way)
+        slo_ms=front_slo_ms,
+        replicas_min=replicas_min,
+        replicas_max=replicas_max,
+        autoscale=autoscale,
     )
     return front.start()
 
@@ -790,6 +815,260 @@ def fleet_mixed(conf_path, tmp_dir, replicas, slo_ms, rows, seconds, log):
         "reloads_fleet": agg["serve.reload"],
         "retraces_fleet": agg["health.retrace"],
     }
+
+
+def ramp_main(args, log) -> int:
+    """--ramp: rising -> falling offered load against a 1-replica fleet
+    with an autoscaling band up to --replicas; records the grow 1->N and
+    shrink N->1 with scale events, shed window, and fleet p99 in a
+    serve_scale artifact (SCALE_rNN.json)."""
+    # env WRITE so spawned replica workers inherit obs collection; the
+    # read stays in knobs.py
+    os.environ.setdefault("YTK_OBS", "1")  # ytklint: allow(undeclared-knob) reason=env write for child worker processes, read stays in knobs.py
+    import tempfile
+
+    from ytklearn_tpu import obs
+    from ytklearn_tpu.serve.batcher import OverloadError
+
+    if knobs.get_raw("YTK_OBS") != "0":
+        obs.configure(enabled=True)
+
+    min_peak = int(os.environ.get("SCALE_MIN_PEAK", "3"))
+    rmin, rmax = 1, args.replicas
+    # fast-tick policy: the ramp must resolve in bench time, not ops time
+    autoscale = dict(
+        interval_s=0.5,
+        up_backlog=192.0, down_backlog=16.0,
+        up_windows=2, down_windows=6,
+        up_cooldown_s=2.0, down_cooldown_s=5.0,
+    )
+    peak_window = args.window * rmax
+    with tempfile.TemporaryDirectory() as tmp_dir:
+        pred, _names, gen_rows, source = _build_model(tmp_dir)
+        trees = len(pred.model.trees)
+        conf_path = _write_serve_conf(tmp_dir, trees)
+        rng = np.random.RandomState(7)
+        frags = [json.dumps(r) for r in gen_rows(rng, args.requests)]
+        log.info("ramp bench: model=%s trees=%d band=[%d, %d] "
+                 "peak window=%d", source, trees, rmin, rmax, peak_window)
+        front = _boot_front(
+            conf_path, rmin, args.slo_ms, 0, 0,
+            # queue bound BELOW the peak offered in-flight: the pre-scale
+            # spike must provably shed (and stop shedding once capacity
+            # lands — the acceptance window)
+            front_queue=max(256, peak_window // 2),
+            replicas_min=rmin, replicas_max=rmax, autoscale=autoscale,
+            front_slo_ms=args.slo_ms,
+        )
+        import threading
+
+        samples = []  # (t, ready, slots, backlog)
+        sampler_stop = threading.Event()
+
+        def sampler():
+            t0s = time.perf_counter()
+            while not sampler_stop.wait(0.25):
+                ready_ids = front._ready_ids()
+                samples.append((
+                    round(time.perf_counter() - t0s, 2),
+                    len(ready_ids),
+                    len(front.handles),
+                    sum(front._load_of(r) for r in ready_ids),
+                ))
+
+        sampler_thread = threading.Thread(target=sampler, daemon=True)
+        sampler_thread.start()
+
+        phases = []  # (name, t_entered)
+        state = {"phase": "warm", "t0": 0.0}
+
+        def enter(phase, t):
+            state["phase"], state["t0"] = phase, t
+            phases.append({"phase": phase, "t_s": round(t, 2),
+                           "ready": len(front._ready_ids())})
+            log.info("ramp phase -> %s at t=%.1fs (ready=%d)",
+                     phase, t, len(front._ready_ids()))
+
+        def window_at(t):
+            ph = state["phase"]
+            ready = len(front._ready_ids())
+            if ph == "warm":
+                if t - state["t0"] >= 3.0:
+                    enter("rise", t)
+                return 16
+            if ph == "rise":
+                if ready >= rmax:
+                    enter("sustain", t)
+                elif t - state["t0"] > args.ramp_grow_timeout:
+                    enter("sustain", t)  # gates judge the peak reached
+                return peak_window
+            if ph == "sustain":
+                if t - state["t0"] >= 3.0:
+                    enter("fall", t)
+                return peak_window
+            if ph == "fall":
+                if ready <= rmin or t - state["t0"] > args.ramp_shrink_timeout:
+                    enter("done", t)
+                    return None
+                return 8
+            return None
+
+        inflight = collections.deque()
+        latencies = []  # (latency_ms, t_submitted)
+        sheds = []
+        failures = []
+        n = i = 0
+        enter("warm", 0.0)
+        t0 = time.perf_counter()
+        try:
+            while True:
+                now = time.perf_counter()
+                t = now - t0
+                w = window_at(t)
+                if w is None and not inflight:
+                    break
+                if w is not None and len(inflight) < w:
+                    try:
+                        submitted = front.submit([frags[i % len(frags)]])
+                    except OverloadError:
+                        submitted = None
+                        sheds.append(round(t, 3))
+                    if submitted is None:
+                        time.sleep(0.002)  # shed storm: brief client backoff
+                        continue
+                    inflight.append((submitted, now))
+                    i += 1
+                    continue
+                if not inflight:
+                    time.sleep(0.005)
+                    continue
+                pending, t_sub = inflight.popleft()
+                try:
+                    pending.get(timeout=300.0)
+                    latencies.append(
+                        ((time.perf_counter() - t_sub) * 1e3,
+                         round(t_sub - t0, 3)))
+                    n += 1
+                except Exception as e:  # noqa: BLE001 — a failed request is the finding
+                    failures.append(f"{type(e).__name__}: {e}"[:200])
+            # "done" fires on the FENCE (ready drops the moment the
+            # victim is fenced) — the drain/SIGTERM may still be in
+            # flight, and the history ring samples once a second: wait
+            # for the topology to settle at the floor and the ring to
+            # record it before taking the end-state evidence
+            settle = time.perf_counter() + 60.0
+            while time.perf_counter() < settle and (
+                len(front.handles) > rmin
+                or len(front._ready_ids()) != rmin
+            ):
+                time.sleep(0.1)
+            time.sleep(2.5)  # >= 2 history samples at the floor
+            metrics = front.metrics_payload(history=True)
+        finally:
+            sampler_stop.set()
+            sampler_thread.join(timeout=5.0)
+            front.stop(drain=True, timeout=60.0)
+
+    peak = max((s[1] for s in samples), default=rmin)
+    end = samples[-1][1] if samples else 0
+    # when did the fleet first hold its peak? sheds after that point mean
+    # capacity arrived and the queues STILL overflowed — a real failure
+    t_peak = next((s[0] for s in samples if s[1] >= peak), 0.0)
+    sheds_after_peak = [s for s in sheds if s > t_peak]
+    lat_all = [m for m, _t in latencies]
+    lat_at_peak = [m for m, t in latencies if t > t_peak]
+    p50, p99 = _lat_stats(lat_all)
+    _p50_pk, p99_pk = _lat_stats(lat_at_peak)
+    scale_events = [
+        {"name": e.get("name"), "ts": round(e.get("ts", 0.0), 3),
+         "args": e.get("args", {})}
+        for e in obs.REGISTRY.events
+        if str(e.get("name", "")).startswith("serve.scale.")
+    ]
+    hist = ((metrics.get("history") or {}).get("series") or {}).get(
+        "serve.fleet.replicas") or []
+    counters = obs.snapshot()["counters"]
+    out = {
+        "schema_version": 1,
+        "schema": "serve_scale",
+        "metric": f"serve_scale_ramp_{source}_gbdt",
+        "value": peak,
+        "unit": "replicas",
+        "replicas_min": rmin,
+        "replicas_max": rmax,
+        "slo_ms": args.slo_ms,
+        "autoscale": autoscale,
+        "data_source": source,
+        "trees": trees,
+        "requests": n,
+        "failures": len(failures),
+        "failure_samples": failures[:3],
+        "shed_429": len(sheds),
+        "shed_window_s": ([round(min(sheds), 2), round(max(sheds), 2)]
+                          if sheds else None),
+        "t_peak_s": round(t_peak, 2),
+        "sheds_after_peak": len(sheds_after_peak),
+        "peak_replicas": peak,
+        "end_replicas": end,
+        "p50_ms": p50,
+        "p99_ms": p99,
+        "p99_at_peak_ms": p99_pk,
+        "phases": phases,
+        "scale_counters": {
+            k: counters.get(k, 0.0)
+            for k in ("serve.scale.up", "serve.scale.down",
+                      "serve.scale.deferred", "serve.scale.blocked")
+        },
+        "scale_events": scale_events,
+        # the metrics-history replica-count ring: the same series an
+        # operator's /metrics?history=1 scrape (and obs_report sparkline)
+        # shows the ramp as
+        "history_replicas": [[round(ts, 2), v] for ts, v in hist],
+        # decimated timeline for the artifact (full resolution is the
+        # history ring's job)
+        "timeline": [list(s) for s in samples[:: max(1, len(samples) // 120)]],
+    }
+    print(json.dumps(out), flush=True)
+    if args.record:
+        with open(args.record, "w") as f:
+            json.dump(out, f, indent=1)
+
+    fails = []
+    if failures:
+        fails.append(
+            f"{len(failures)} request failure(s) across the ramp: "
+            f"{failures[:3]} (sheds are expected; failures are not)"
+        )
+    if peak < min_peak:
+        fails.append(
+            f"fleet only reached {peak} replica(s) under the rising load "
+            f"(want >= {min_peak}; env SCALE_MIN_PEAK)"
+        )
+    if end != rmin:
+        fails.append(
+            f"fleet ended at {end} replica(s), not the {rmin} floor "
+            "(scale-down never completed)"
+        )
+    if sheds_after_peak:
+        fails.append(
+            f"{len(sheds_after_peak)} shed(s) AFTER the fleet reached its "
+            f"peak at t={t_peak:.1f}s — sheds must be confined to the "
+            "pre-scale window"
+        )
+    ev_names = {e["name"] for e in scale_events}
+    if "serve.scale.up" not in ev_names or "serve.scale.down" not in ev_names:
+        fails.append(
+            f"scale decisions missing from the flight ring: {sorted(ev_names)}"
+        )
+    hist_vals = [v for _ts, v in hist]
+    if not hist_vals or max(hist_vals) < min_peak or hist_vals[-1] != rmin:
+        fails.append(
+            "the /metrics?history=1 serve.fleet.replicas ring does not "
+            f"show the ramp (series tail: {hist_vals[-8:]})"
+        )
+    for msg in fails:
+        log.error("FAIL: %s", msg)
+    return 1 if fails else 0
 
 
 def fleet_main(args, log) -> int:
@@ -956,6 +1235,17 @@ def main() -> int:
     ap.add_argument("--fleet", action="store_true",
                     help="run the fleet scenario matrix instead of the "
                     "single-process bench (schema serve_fleet)")
+    ap.add_argument("--ramp", action="store_true",
+                    help="run the autoscaler ramp scenario: rising -> "
+                    "falling offered load against a 1-replica fleet with "
+                    "--replicas as the autoscaling ceiling (schema "
+                    "serve_scale; record as SCALE_rNN.json)")
+    ap.add_argument("--ramp-grow-timeout", type=float, default=300.0,
+                    help="max seconds to wait for the fleet to reach the "
+                    "ceiling under the rising load")
+    ap.add_argument("--ramp-shrink-timeout", type=float, default=180.0,
+                    help="max seconds to wait for the fleet to drain back "
+                    "to the floor after the load falls")
     ap.add_argument("--rungs-fleet", type=int, default=0,
                     help="after the rung matrix, boot an N-replica fleet "
                     "inheriting the binned rung and embed its run (plus "
@@ -977,6 +1267,8 @@ def main() -> int:
                         format="%(asctime)s %(name)s %(levelname)s %(message)s")
     log = logging.getLogger("serve_bench")
 
+    if args.ramp:
+        return ramp_main(args, log)
     if args.fleet:
         return fleet_main(args, log)
 

@@ -1,0 +1,503 @@
+"""Load-driven fleet autoscaler: policy decisions, drain-based scale-down,
+Retry-After shed hints (ISSUE 14, docs/serving.md "Load-driven autoscaling").
+
+Policy tests drive synthetic signal streams through the EXACT production
+decision code (AutoscalePolicy is pure — injectable clock, no threads).
+Fleet tests spawn tests/fleet_stub_worker.py so grow/drain drills cost
+milliseconds per process; the control thread shares the front with the
+monitor and balancer, so the e2e tests are `@pytest.mark.threaded` and
+run under `pytest --ytk-lockwatch` too.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from ytklearn_tpu import obs
+from ytklearn_tpu.serve import BatchPolicy, FleetFront, ModelRegistry, ServeApp
+from ytklearn_tpu.serve.batcher import (
+    RETRY_AFTER_MAX_S,
+    ScoredRateWindow,
+    retry_after_s,
+)
+from ytklearn_tpu.serve.fleet.autoscaler import (
+    AutoscalePolicy,
+    ScaleSignals,
+    maybe_autoscaler,
+)
+
+STUB = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                    "fleet_stub_worker.py")
+
+
+@pytest.fixture()
+def obs_on():
+    obs.configure(enabled=True)
+    obs.reset()
+    yield
+    obs.configure(enabled=False)
+    obs.reset()
+
+
+def _policy(**kw):
+    kw.setdefault("up_backlog", 100.0)
+    kw.setdefault("down_backlog", 10.0)
+    kw.setdefault("up_windows", 3)
+    kw.setdefault("down_windows", 5)
+    kw.setdefault("up_cooldown_s", 5.0)
+    kw.setdefault("down_cooldown_s", 10.0)
+    return AutoscalePolicy(kw.pop("min", 1), kw.pop("max", 4), **kw)
+
+
+def _sig(backlog=0, ready=1, slots=None, unsettled=0, shed=0.0, p99=0.0,
+         burn=0.0):
+    return ScaleSignals(
+        backlog_rows=backlog, ready=ready,
+        slots=slots if slots is not None else ready,
+        unsettled=unsettled, shed=shed, p99_ms=p99, slo_burn=burn,
+    )
+
+
+# ---------------------------------------------------------------------------
+# policy: threshold crossing / hysteresis / cooldowns / defer / blocked
+# ---------------------------------------------------------------------------
+
+
+def test_policy_threshold_crossing_needs_consecutive_windows():
+    p = _policy(up_windows=3)
+    # two overloaded ticks: below the window — no decision
+    assert p.decide(_sig(backlog=500), now=0.0).action is None
+    assert p.decide(_sig(backlog=500), now=1.0).action is None
+    d = p.decide(_sig(backlog=500), now=2.0)
+    assert d.action == "up"
+    # the decision event names the signal values that triggered it
+    assert d.reason["backlog_rows"] == 500 and d.reason["streak"] == 3
+
+
+def test_policy_every_overload_signal_counts():
+    for sig in (
+        _sig(shed=3.0),  # typed 429s this tick
+        _sig(burn=1.0),  # health.slo_burn fired
+        _sig(p99=150.0),  # p99 over the SLO
+    ):
+        p = _policy(up_windows=1, slo_ms=100.0)
+        assert p.decide(sig, now=0.0).action == "up", sig
+
+
+def test_policy_hysteresis_band_resets_both_streaks():
+    """Backlog between the down and up thresholds is the hysteresis band:
+    neither streak survives it, so the fleet cannot flap around either
+    threshold edge."""
+    p = _policy(up_windows=2, down_windows=2)
+    # 2 overloaded ticks would fire — but a band tick in between resets
+    assert p.decide(_sig(backlog=500), now=0.0).action is None
+    assert p.decide(_sig(backlog=50), now=1.0).action is None  # in the band
+    assert p.decide(_sig(backlog=500), now=2.0).action is None  # streak=1 again
+    # same for the down side: idle, band, idle, band, ... never fires
+    for i in range(10):
+        backlog = 0 if i % 2 == 0 else 50
+        d = p.decide(_sig(backlog=backlog, ready=2, slots=2), now=3.0 + i)
+        assert d.action is None, (i, d)
+
+
+def test_policy_cooldown_suppresses_then_releases():
+    p = _policy(up_windows=1, up_cooldown_s=5.0)
+    assert p.decide(_sig(backlog=500), now=0.0).action == "up"
+    # sustained overload inside the cooldown: SILENTLY suppressed (no
+    # counter spam), streak stays saturated
+    for t in (1.0, 2.0, 4.9):
+        d = p.decide(_sig(backlog=500, ready=2, slots=2), now=t)
+        assert d.action is None and d.want == "up", (t, d)
+    # first tick past the cooldown fires immediately
+    assert p.decide(_sig(backlog=500, ready=2, slots=2), now=5.1).action == "up"
+
+
+def test_policy_scale_up_pushes_down_cooldown():
+    """Capacity a spike just paid for is never reaped the moment the
+    spike ends: a scale-up arms the DOWN cooldown too."""
+    p = _policy(up_windows=1, down_windows=1, up_cooldown_s=1.0,
+                down_cooldown_s=20.0)
+    assert p.decide(_sig(backlog=500), now=0.0).action == "up"
+    # now idle — but the down cooldown from the up decision holds
+    for t in (1.0, 5.0, 19.9):
+        d = p.decide(_sig(backlog=0, ready=2, slots=2), now=t)
+        assert d.action is None, (t, d)
+    assert p.decide(_sig(backlog=0, ready=2, slots=2), now=20.1).action == "down"
+
+
+def test_policy_defers_while_respawn_in_flight():
+    """A dead or starting slot means the monitor is already delivering
+    capacity: decisions wait (and the slot still counts against max), so
+    heal + autoscale can never double-spawn."""
+    p = _policy(up_windows=1)
+    d = p.decide(_sig(backlog=500, ready=1, slots=2, unsettled=1), now=0.0)
+    assert d.action == "deferred" and d.want == "up"
+    # the pressure is not lost: the moment the slot settles, the
+    # saturated streak fires
+    d = p.decide(_sig(backlog=500, ready=2, slots=2), now=1.0)
+    assert d.action == "up"
+    # the down direction defers the same way
+    p2 = _policy(up_windows=1, down_windows=1, down_cooldown_s=0.0)
+    d = p2.decide(_sig(backlog=0, ready=2, slots=3, unsettled=1), now=0.0)
+    assert d.action == "deferred" and d.want == "down"
+
+
+def test_policy_blocked_at_bounds_once_per_streak():
+    p = _policy(min=1, max=2, up_windows=2)
+    assert p.decide(_sig(backlog=500, ready=2, slots=2), now=0.0).action is None
+    d = p.decide(_sig(backlog=500, ready=2, slots=2), now=1.0)
+    assert d.action == "blocked" and d.want == "up"
+    # streak was reset: the very next tick does NOT re-block (no spam);
+    # it takes a full streak to report again
+    assert p.decide(_sig(backlog=500, ready=2, slots=2), now=2.0).action is None
+    assert p.decide(_sig(backlog=500, ready=2, slots=2), now=3.0).action == "blocked"
+    # down at the floor blocks too
+    p2 = _policy(min=2, max=4, down_windows=1)
+    d = p2.decide(_sig(backlog=0, ready=2, slots=2), now=0.0)
+    assert d.action == "blocked" and d.want == "down"
+
+
+def test_policy_validates_band_and_thresholds():
+    with pytest.raises(ValueError):
+        AutoscalePolicy(0, 4)
+    with pytest.raises(ValueError):
+        AutoscalePolicy(4, 2)
+    with pytest.raises(ValueError):
+        AutoscalePolicy(1, 4, up_backlog=10.0, down_backlog=20.0)
+
+
+def test_maybe_autoscaler_disarmed_on_degenerate_band():
+    assert maybe_autoscaler(None, 2, 2) is None
+    a = maybe_autoscaler(object(), 1, 3, params={"interval_s": 0.5,
+                                                 "up_windows": 1})
+    assert a is not None and a.interval_s == 0.5
+    assert a.policy.min_replicas == 1 and a.policy.max_replicas == 3
+
+
+# ---------------------------------------------------------------------------
+# Retry-After arithmetic
+# ---------------------------------------------------------------------------
+
+
+def test_retry_after_clamps_and_estimates():
+    w = ScoredRateWindow(window_s=10.0)
+    # no drain evidence -> the clamp bound (honest worst case)
+    assert retry_after_s(500, w) == RETRY_AFTER_MAX_S
+    # backdated samples: 1000 rows over the last ~5s -> ~200 rows/s
+    now = time.time()
+    w._ring.append((now - 5.0, 600))
+    w._ring.append((now - 2.5, 300))
+    w._ring.append((now, 100))
+    assert retry_after_s(100, w) == 1  # ceil(100/~200) = 1
+    # ~200 rows/s (the measured span runs slightly past the oldest
+    # sample, so the rate lands just under 200): ceil(1000/rate)
+    assert retry_after_s(1000, w) in (5, 6)
+    assert retry_after_s(10_000_000, w) == RETRY_AFTER_MAX_S  # clamped
+    assert retry_after_s(0, w) == 1  # floor: never "retry in 0s"
+
+
+def test_retry_after_rate_uses_covered_span_not_window():
+    """The bounded ring may hold far less than window_s of history under
+    load: the rate must divide by the span the samples actually cover —
+    dividing by the full window would underestimate a 50k-rows/s process
+    ~500x and peg every Retry-After at the clamp bound."""
+    w = ScoredRateWindow(window_s=10.0, maxlen=64)
+    now = time.time()
+    # 64 samples of 100 rows covering only the last 0.5s: 12.8k rows/s
+    for i in range(64):
+        w._ring.append((now - 0.5 + i * (0.5 / 64), 100))
+    assert w.rows_per_s() > 6000  # NOT 6400/10 = 640
+    assert retry_after_s(6400, w) == 1  # drains in ~0.5s, not 8s
+
+
+# ---------------------------------------------------------------------------
+# HTTP Retry-After: replica/solo path and fleet-front path
+# ---------------------------------------------------------------------------
+
+
+def _http(port, path, payload=None, method=None, timeout=30.0):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode() if payload is not None else None,
+        headers={"Content-Type": "application/json"},
+        method=method or ("POST" if payload is not None else "GET"),
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, dict(resp.headers), json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), json.loads(e.read())
+
+
+def test_replica_shed_429_carries_retry_after(tmp_path):
+    """Solo/replica path: queue full -> typed 429 WITH a clamped
+    Retry-After queue-drain hint."""
+    path = tmp_path / "ra.model"
+    path.write_text("c0,1.000000,1.0\n_bias_,0.0\n")
+    cfg = {"model": {"data_path": str(path)},
+           "loss": {"loss_function": "sigmoid"}}
+    reg = ModelRegistry(ladder=(1, 4), watch_interval_s=0)
+    reg.load("default", "linear", cfg)
+    app = ServeApp(reg, BatchPolicy(max_batch=1, max_wait_ms=0.0,
+                                    max_queue=1), port=0).start()
+    gate = threading.Event()
+    b = app.batcher_for("default")
+    real_score = b.score_fn
+
+    def blocking_score(rows):
+        gate.wait(timeout=30.0)
+        return real_score(rows)
+
+    b.score_fn = blocking_score
+    results = []
+
+    def client(i):
+        results.append(_http(app.port, "/predict",
+                             {"features": {"c0": float(i)}}))
+
+    t1 = threading.Thread(target=client, args=(1,))
+    t2 = threading.Thread(target=client, args=(2,))
+    try:
+        t1.start()
+        time.sleep(0.3)  # request 1 is in the (gated) scorer
+        t2.start()
+        time.sleep(0.3)  # request 2 is the single queued slot
+        # queue is full: this one is shed synchronously
+        status, headers, body = _http(app.port, "/predict",
+                                      {"features": {"c0": 3.0}})
+        assert status == 429 and body["type"] == "overload"
+        ra = headers.get("Retry-After")
+        assert ra is not None, "429 lost its Retry-After header"
+        assert 1 <= int(ra) <= RETRY_AFTER_MAX_S
+    finally:
+        gate.set()
+        t1.join(timeout=15.0)
+        t2.join(timeout=15.0)
+        app.stop(drain=True)
+    # the gated requests completed normally once released
+    assert sorted(s for s, _h, _b in results) == [200, 200]
+
+
+@pytest.mark.threaded
+def test_front_shed_429_carries_retry_after(obs_on):
+    """Fleet-front path: forwarder queue full -> 429 with Retry-After."""
+    front = FleetFront(
+        [sys.executable, STUB, "--weight", "2.0", "--delay-ms", "500"],
+        1,
+        policy=BatchPolicy(max_batch=1, max_wait_ms=0.0, max_queue=1),
+        ready_timeout_s=30.0, monitor_interval_s=0.2,
+    ).start().serve_http()
+    done = []
+
+    def client(i):
+        done.append(_http(front.port, "/predict",
+                          {"features": {"x": float(i)}}))
+
+    t1 = threading.Thread(target=client, args=(1,))
+    t2 = threading.Thread(target=client, args=(2,))
+    try:
+        t1.start()
+        time.sleep(0.2)  # request 1 inside the 500ms stub call
+        t2.start()
+        time.sleep(0.2)  # request 2 queued (the single slot)
+        status, headers, body = _http(front.port, "/predict",
+                                      {"features": {"x": 3.0}})
+        assert status == 429 and body["type"] == "overload"
+        ra = headers.get("Retry-After")
+        assert ra is not None, "front 429 lost its Retry-After header"
+        assert 1 <= int(ra) <= RETRY_AFTER_MAX_S
+    finally:
+        t1.join(timeout=30.0)
+        t2.join(timeout=30.0)
+        front.stop(drain=True, timeout=15.0)
+    assert sorted(s for s, _h, _b in done) == [200, 200]
+
+
+# ---------------------------------------------------------------------------
+# fleet e2e over stub workers: grow under backlog, drain-based shrink
+# ---------------------------------------------------------------------------
+
+
+def _autoscale_front(replicas=1, rmin=1, rmax=2, stub_flags=(), params=None,
+                     **kw):
+    kw.setdefault("policy", BatchPolicy(max_batch=64, max_wait_ms=0.5,
+                                        max_queue=4096))
+    kw.setdefault("ready_timeout_s", 30.0)
+    kw.setdefault("monitor_interval_s", 0.1)
+    return FleetFront(
+        [sys.executable, STUB, "--weight", "2.0", *stub_flags],
+        replicas, replicas_min=rmin, replicas_max=rmax,
+        autoscale=params, **kw,
+    )
+
+
+@pytest.mark.threaded
+def test_fleet_grows_under_backlog_and_drain_shrinks(obs_on):
+    """The acceptance loop in miniature: injected backlog (slow stub +
+    16 client threads) grows the fleet 1->2, idling shrinks it back to 1
+    via the drain path, and not one request is lost or wrong along the
+    way. Evidence: serve.scale.{up,down} counters + ring events and the
+    LIVE serve.fleet.replicas gauge."""
+    front = _autoscale_front(
+        replicas=1, rmin=1, rmax=2, stub_flags=("--delay-ms", "20"),
+        params=dict(interval_s=0.05, up_backlog=8, down_backlog=2,
+                    up_windows=2, down_windows=5,
+                    up_cooldown_s=0.2, down_cooldown_s=0.3),
+    ).start()
+    assert front.autoscaler is not None
+    results, errors = [], []
+    stop = threading.Event()
+
+    def pump(tid):
+        i = 0
+        while not stop.is_set():
+            n = tid * 100000 + i
+            try:
+                out = front.predict([{"x": float(n)}], timeout=30.0)
+                assert out["scores"][0] == pytest.approx(2.0 * n)
+                results.append(out["replica"])
+            except Exception as e:  # noqa: BLE001 — collected for the assert
+                errors.append(repr(e))
+            i += 1
+
+    threads = [threading.Thread(target=pump, args=(t,)) for t in range(16)]
+    try:
+        for t in threads:
+            t.start()
+        deadline = time.time() + 30.0
+        while time.time() < deadline and len(front._ready_ids()) < 2:
+            time.sleep(0.05)
+        assert len(front._ready_ids()) == 2, "fleet did not grow under load"
+        # live gauge tracks the grow (not the startup constant)
+        assert obs.snapshot()["gauges"].get("serve.fleet.replicas") == 2.0
+        time.sleep(0.3)  # traffic actually flows over the new replica
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=30.0)
+    try:
+        assert not errors, f"requests failed across the ramp: {errors[:3]}"
+        # load gone -> idle streak -> drain-based shrink back to the floor
+        deadline = time.time() + 20.0
+        while time.time() < deadline and len(front._ready_ids()) > 1:
+            time.sleep(0.05)
+        assert len(front._ready_ids()) == 1, "fleet did not shrink when idle"
+        assert sorted(front.handles) == [0]
+        assert obs.snapshot()["gauges"].get("serve.fleet.replicas") == 1.0
+        c = obs.snapshot()["counters"]
+        assert c.get("serve.scale.up", 0) >= 1
+        assert c.get("serve.scale.down", 0) >= 1
+        ev = {e.get("name") for e in obs.REGISTRY.events}
+        assert {"serve.scale.up", "serve.scale.up_ready",
+                "serve.scale.down", "serve.scale.drain",
+                "serve.scale.down_done"} <= ev
+        # decision events name the signals that triggered them
+        up_ev = next(e for e in obs.REGISTRY.events
+                     if e.get("name") == "serve.scale.up")
+        assert "backlog_rows" in up_ev["args"] and "p99_ms" in up_ev["args"]
+        # both replicas actually served traffic during the ramp
+        assert {0, 1} <= set(results)
+        # /metrics carries the autoscale block
+        m = front.metrics_payload()
+        assert m["autoscale"]["enabled"] is True
+        assert m["autoscale"]["min"] == 1 and m["autoscale"]["max"] == 2
+        assert m["autoscale"]["last_decision"]["action"] == "down"
+    finally:
+        front.stop(drain=True, timeout=15.0)
+
+
+@pytest.mark.threaded
+def test_scale_down_drain_fence_loses_zero_inflight(obs_on):
+    """The drain-fence contract, driven directly: a victim with queued
+    work is fenced, its batches complete or reroute, and only then is it
+    stopped — every response still arrives, bit-correct."""
+    front = _autoscale_front(
+        replicas=2, rmin=1, rmax=2, stub_flags=("--delay-ms", "150"),
+        # armed but inert: the test drives scale_down() by hand
+        params=dict(interval_s=0.5, up_windows=10 ** 6,
+                    down_windows=10 ** 6),
+    ).start()
+    pendings = []
+    try:
+        # a burst of slow requests so BOTH forwarders hold queued rows
+        for i in range(24):
+            pendings.append((i, front.submit([{"x": float(i)}])))
+        time.sleep(0.05)  # some batches in flight, some queued
+        reaped = front.scale_down(timeout=30.0)
+        assert reaped is not None
+        # zero in-flight loss: every single request completes, correct
+        for i, p in pendings:
+            scores, _preds = p.get(timeout=30.0)
+            assert scores[0] == pytest.approx(2.0 * i)
+        assert len(front._ready_ids()) == 1
+        assert reaped not in front.handles
+        survivor = front._ready_ids()[0]
+        # the fence held: post-reap traffic goes to the survivor only
+        for i in range(5):
+            out = front.predict([{"x": 1.0}], timeout=15.0)
+            assert out["replica"] == survivor
+        ev = {e.get("name") for e in obs.REGISTRY.events}
+        assert {"serve.scale.drain", "serve.scale.down_done"} <= ev
+        # floor respected: a second reap refuses (min=1)
+        assert front.scale_down(timeout=5.0) is None
+    finally:
+        front.stop(drain=True, timeout=15.0)
+
+
+@pytest.mark.threaded
+def test_submit_repicks_when_victim_fenced_between_pick_and_enqueue(obs_on):
+    """The fence race: a handler thread's _pick_replica returns the
+    victim, then the scale-down fences it and closes its forwarder
+    before the enqueue lands. submit() must re-pick a live replica —
+    not surface a spurious 503 from a fleet that is not draining."""
+    front = _autoscale_front(
+        replicas=2, rmin=1, rmax=2,
+        params=dict(interval_s=0.5, up_windows=10 ** 6,
+                    down_windows=10 ** 6),
+    ).start()
+    try:
+        victim = sorted(front._ready_ids())[-1]
+        survivor = sorted(front._ready_ids())[0]
+        stale = [victim]
+        real_pick = FleetFront._pick_replica
+
+        def racy_pick():
+            # first call hands back the pre-fence stale pick, like a
+            # thread preempted between pick and enqueue
+            return stale.pop() if stale else real_pick(front)
+
+        front._pick_replica = racy_pick
+        # what scale_down does first: fence, then close the forwarder
+        front.handles[victim].state = "draining"
+        front._forwarders[victim].close(drain=True, timeout=5.0)
+        out = front.predict([{"x": 2.0}], timeout=15.0)
+        assert out["scores"][0] == pytest.approx(4.0)
+        assert out["replica"] == survivor
+        # same race one step later: the slot is fully REMOVED before the
+        # stale pick is consumed — submit must skip the missing forwarder
+        front._remove_slot(victim, drain_forwarder=False)
+        stale.append(victim)
+        out = front.predict([{"x": 3.0}], timeout=15.0)
+        assert out["scores"][0] == pytest.approx(6.0)
+        assert out["replica"] == survivor
+    finally:
+        front.stop(drain=True, timeout=15.0)
+
+
+def test_front_clamps_initial_replicas_into_band(obs_on):
+    """--replicas below the floor starts at the floor; a fixed fleet
+    (no band) reports a disabled autoscale block."""
+    front = _autoscale_front(replicas=1, rmin=2, rmax=3,
+                             params=dict(up_windows=10 ** 6,
+                                         down_windows=10 ** 6))
+    assert front.n_replicas == 2
+    fixed = FleetFront([sys.executable, STUB], 1, ready_timeout_s=30.0)
+    assert fixed.autoscaler is None
+    with pytest.raises(ValueError):
+        FleetFront([sys.executable, STUB], 1, replicas_min=3, replicas_max=2)

@@ -229,6 +229,82 @@ def test_fleet_gate_compares_same_rung(tmp_path, capsys):
     assert "fleet throughput regressed" in capsys.readouterr().err
 
 
+def _scale_artifact(tmp_path, rnd, peak, end=1, failures=0,
+                    sheds_after_peak=0, rmin=1, rmax=4,
+                    metric="serve_scale_ramp_synthetic_gbdt"):
+    rec = {
+        "schema_version": 1,
+        "schema": "serve_scale",
+        "metric": metric,
+        "value": peak,
+        "unit": "replicas",
+        "replicas_min": rmin,
+        "replicas_max": rmax,
+        "peak_replicas": peak,
+        "end_replicas": end,
+        "failures": failures,
+        "shed_429": 100,
+        "sheds_after_peak": sheds_after_peak,
+    }
+    (tmp_path / f"SCALE_r{rnd:02d}.json").write_text(json.dumps(rec))
+
+
+def test_ramp_gate_skips_without_artifacts(tmp_path, capsys):
+    assert gate_main(["--dir", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "ramp: no serve_scale artifact (skip)" in out
+    assert "SKIP ramp pair gate" in out
+
+
+def test_ramp_gate_absolute_on_single_artifact(tmp_path, capsys):
+    _scale_artifact(tmp_path, 18, peak=4, end=1)
+    assert gate_main(["--dir", str(tmp_path)]) == 0
+    assert "SKIP ramp pair gate" in capsys.readouterr().out
+    # the same single artifact fails absolutely on a recorded failure,
+    # a missed shrink, or post-peak sheds
+    _scale_artifact(tmp_path, 18, peak=4, end=1, failures=2)
+    assert gate_main(["--dir", str(tmp_path)]) == 1
+    assert "zero-loss contract" in capsys.readouterr().err
+    _scale_artifact(tmp_path, 18, peak=4, end=3)
+    assert gate_main(["--dir", str(tmp_path)]) == 1
+    assert "not the 1 floor" in capsys.readouterr().err
+    _scale_artifact(tmp_path, 18, peak=4, end=1, sheds_after_peak=7)
+    assert gate_main(["--dir", str(tmp_path)]) == 1
+    assert "after the fleet reached its peak" in capsys.readouterr().err
+
+
+def test_ramp_gate_pairs_same_band_only(tmp_path, capsys):
+    # different (min, max) band: no pair, skip cleanly
+    _scale_artifact(tmp_path, 18, peak=4, rmin=1, rmax=4)
+    _scale_artifact(tmp_path, 19, peak=2, rmin=1, rmax=2)
+    assert gate_main(["--dir", str(tmp_path)]) == 0
+    assert "SKIP ramp pair gate" in capsys.readouterr().out
+    # same band, peak regressed: the elasticity story broke
+    _scale_artifact(tmp_path, 20, peak=2, rmin=1, rmax=4)
+    assert gate_main(["--dir", str(tmp_path)]) == 1
+    assert "ramp peak regressed" in capsys.readouterr().err
+    # same band, peak held: green
+    _scale_artifact(tmp_path, 21, peak=4, rmin=1, rmax=4)
+    assert gate_main(["--dir", str(tmp_path)]) == 0
+
+
+def test_ramp_gate_real_recorded_artifact():
+    """The checked-in SCALE_r18.json satisfies the absolute gate facts."""
+    from check_bench_regress import read_scale_record
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(repo, "SCALE_r18.json")
+    if not os.path.exists(path):
+        pytest.skip("no recorded scale artifact")
+    rec = read_scale_record(path)
+    assert rec is not None
+    assert rec["peak_replicas"] >= 3
+    assert rec["end_replicas"] == rec["replicas_min"]
+    assert rec["failures"] == 0
+    assert rec["sheds_after_peak"] == 0
+    assert rec["shed_429"] > 0  # the pre-scale spike provably shed
+
+
 def test_gate_real_recorded_artifact_shape():
     """The checked-in SERVE_r09.json parses as a default-rung record."""
     from check_bench_regress import read_serve_records

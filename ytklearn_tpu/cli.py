@@ -502,6 +502,17 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
                     "processes behind a shared-nothing front (-1 = one per "
                     "device, or per core on CPU; 0 = single-process; env "
                     "YTK_SERVE_REPLICAS — see docs/serving.md)")
+    ap.add_argument("--replicas-min", type=int, default=None,
+                    help="fleet autoscaler floor: minimum replica slots "
+                    "(default: --replicas; env YTK_SERVE_REPLICAS_MIN — "
+                    "see docs/serving.md autoscaling)")
+    ap.add_argument("--replicas-max", type=int, default=None,
+                    help="fleet autoscaler ceiling: maximum replica slots "
+                    "(default: --replicas, which disarms autoscaling; env "
+                    "YTK_SERVE_REPLICAS_MAX). A band wider than one value "
+                    "arms the load-driven autoscaler: the front grows or "
+                    "drain-reaps replicas within [min, max] from backlog/"
+                    "shed/p99 signals")
     ap.add_argument("--slo-ms", type=float, default=None,
                     help="p99 latency SLO in ms for the AIMD batch-size "
                     "controller (0 disables AIMD and restores the fixed "
@@ -531,9 +542,17 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
               else knobs.get_float("YTK_SERVE_SLO_MS"))
     cache_rows = (args.cache_rows if args.cache_rows is not None
                   else knobs.get_int("YTK_SERVE_CACHE_ROWS"))
+    # autoscaling band (0 / unset = follow --replicas = fixed fleet); a
+    # band alone is enough to go fleet mode: `--replicas-max 4` on a
+    # default single-process invocation serves one replica that can grow
+    r_min = (args.replicas_min if args.replicas_min is not None
+             else knobs.get_int("YTK_SERVE_REPLICAS_MIN")) or 0
+    r_max = (args.replicas_max if args.replicas_max is not None
+             else knobs.get_int("YTK_SERVE_REPLICAS_MAX")) or 0
 
-    if replicas != 0:
-        return _serve_fleet_main(args, replicas, slo_ms, cache_rows)
+    if replicas != 0 or r_max > 0 or r_min > 0:
+        return _serve_fleet_main(args, replicas, slo_ms, cache_rows,
+                                 r_min, r_max)
 
     from .config import hocon
     from . import obs
@@ -581,7 +600,8 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
     return 0
 
 
-def _serve_fleet_main(args, replicas: int, slo_ms, cache_rows) -> int:
+def _serve_fleet_main(args, replicas: int, slo_ms, cache_rows,
+                      r_min: int = 0, r_max: int = 0) -> int:
     """`serve --replicas N`: front process owning N worker subprocesses."""
     from .serve import (
         BatchPolicy,
@@ -592,6 +612,10 @@ def _serve_fleet_main(args, replicas: int, slo_ms, cache_rows) -> int:
 
     if replicas < 0:
         replicas = default_replica_count()
+    if replicas == 0:
+        # reached via a bare autoscaling band (--replicas-max without
+        # --replicas): start at the floor and let load grow the fleet
+        replicas = max(1, r_min)
     worker_flags = []
     for flag, val in (
         ("--name", args.name),
@@ -622,6 +646,8 @@ def _serve_fleet_main(args, replicas: int, slo_ms, cache_rows) -> int:
         host=args.host,
         port=args.port,
         slo_ms=slo_ms,
+        replicas_min=(r_min or None),
+        replicas_max=(r_max or None),
     )
     front.start().serve_http()
     front.install_signal_handlers()
@@ -632,7 +658,10 @@ def _serve_fleet_main(args, replicas: int, slo_ms, cache_rows) -> int:
         "model": args.model_name,
         "host": args.host,
         "port": front.port,
-        "replicas": replicas,
+        "replicas": front.n_replicas,
+        "replicas_min": front.replicas_min,
+        "replicas_max": front.replicas_max,
+        "autoscale": front.autoscaler is not None,
         "fleet": True,
         "replica_ports": {
             str(rid): h.port for rid, h in sorted(front.handles.items())

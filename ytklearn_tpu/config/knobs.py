@@ -265,6 +265,38 @@ _knob("YTK_SERVE_AIMD_WINDOW", "int", 16,
       "batches per AIMD adjustment window: the controller judges the "
       "window's worst observed request latency against the SLO once per "
       "window, so one straggler cannot collapse the batch size")
+_knob("YTK_SERVE_REPLICAS_MIN", "int", 0,
+      "fleet autoscaler floor: minimum replica slots the autoscaler may "
+      "reap down to (`0` = follow `--replicas`; CLI `--replicas-min` "
+      "overrides — see [serving.md](serving.md) autoscaling)")
+_knob("YTK_SERVE_REPLICAS_MAX", "int", 0,
+      "fleet autoscaler ceiling: maximum replica slots the autoscaler "
+      "may grow to (`0` = follow `--replicas`, which disarms "
+      "autoscaling; CLI `--replicas-max` overrides)")
+_knob("YTK_SERVE_SCALE_INTERVAL_S", "float", 1.0,
+      "autoscaler decision-tick interval in seconds (each tick samples "
+      "the windowed load signals and advances the hysteresis streaks)")
+_knob("YTK_SERVE_SCALE_UP_BACKLOG", "float", 256.0,
+      "scale-up backlog threshold in queued+in-flight rows PER READY "
+      "REPLICA: a tick above it (or any shed / p99-over-SLO / slo-burn "
+      "fire) counts as overloaded")
+_knob("YTK_SERVE_SCALE_DOWN_BACKLOG", "float", 16.0,
+      "scale-down backlog threshold in rows per ready replica: a tick "
+      "below it with zero sheds and p99 comfortably inside the SLO "
+      "counts as idle (the gap up to the scale-up threshold is the "
+      "hysteresis band)")
+_knob("YTK_SERVE_SCALE_UP_WINDOWS", "int", 3,
+      "consecutive overloaded ticks required before the autoscaler "
+      "grows the fleet (one bursty tick cannot spawn a replica)")
+_knob("YTK_SERVE_SCALE_DOWN_WINDOWS", "int", 10,
+      "consecutive idle ticks required before the autoscaler reaps a "
+      "replica (drain-based: fenced, completed/rerouted, then SIGTERM)")
+_knob("YTK_SERVE_SCALE_UP_COOLDOWN_S", "float", 5.0,
+      "seconds after a scale-up before the next scale-up may fire (new "
+      "capacity must land and be judged before growing again)")
+_knob("YTK_SERVE_SCALE_DOWN_COOLDOWN_S", "float", 30.0,
+      "seconds after ANY scale decision before a scale-down may fire "
+      "(capacity a spike just paid for is never reaped immediately)")
 
 # -- bench ------------------------------------------------------------------
 _knob("YTK_CHIP", "str", "v5e",

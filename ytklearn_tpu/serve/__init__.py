@@ -40,6 +40,8 @@ from .scorer import DEFAULT_LADDER, CompiledScorer, parse_ladder  # noqa: F401
 from .server import ServeApp  # noqa: F401
 from .fleet import (  # noqa: F401
     AIMDController,
+    AutoscalePolicy,
+    FleetAutoscaler,
     FleetFront,
     PredictionCache,
     default_replica_count,
@@ -48,10 +50,12 @@ from .fleet import (  # noqa: F401
 
 __all__ = [
     "AIMDController",
+    "AutoscalePolicy",
     "BatchPolicy",
     "CompiledScorer",
     "DEFAULT_LADDER",
     "DeadlineExceeded",
+    "FleetAutoscaler",
     "FleetFront",
     "MicroBatcher",
     "ModelRegistry",

@@ -20,13 +20,27 @@ multi-replica fan-out itself:
                     row); hits bypass the batcher queue and are
                     bit-identical to the scored path; hot reload
                     invalidates by key, for free
+  AutoscalePolicy / FleetAutoscaler
+                    load-driven replica-count elasticity: a control
+                    thread watches windowed load signals (backlog, shed
+                    rate, p99 vs SLO, slo-burn) and grows or reaps slots
+                    within `--replicas-min/--replicas-max` with
+                    hysteresis + per-direction cooldowns; scale-down is
+                    drain-based (fence, complete/reroute, SIGTERM)
 
-CLI: `ytklearn-tpu-serve <conf> <model> --replicas N` (cli.py).
+CLI: `ytklearn-tpu-serve <conf> <model> --replicas N
+      [--replicas-min A --replicas-max B]` (cli.py).
 """
 
 from __future__ import annotations
 
 from .aimd import AIMDController, maybe_controller  # noqa: F401
+from .autoscaler import (  # noqa: F401
+    AutoscalePolicy,
+    FleetAutoscaler,
+    ScaleSignals,
+    maybe_autoscaler,
+)
 from .cache import PredictionCache, maybe_cache, row_key  # noqa: F401
 from .front import FleetFront, latency_percentiles  # noqa: F401
 from .worker import (  # noqa: F401
@@ -41,13 +55,17 @@ from .worker import (  # noqa: F401
 
 __all__ = [
     "AIMDController",
+    "AutoscalePolicy",
+    "FleetAutoscaler",
     "FleetFront",
     "PredictionCache",
     "ReplicaHandle",
+    "ScaleSignals",
     "WorkerStartupError",
     "default_replica_count",
     "http_json",
     "latency_percentiles",
+    "maybe_autoscaler",
     "maybe_cache",
     "maybe_controller",
     "row_key",

@@ -20,6 +20,18 @@ holds no model state at all — it only moves rows:
              die with a replica are rerouted to a sibling — the
              transient-vs-fatal split is `resilience.retry.is_transient`
              (a connection reset reroutes; a model bug propagates)
+  autoscale  an optional control thread (autoscaler.py) watches windowed
+             load signals (forwarder backlog, shed rate, client-visible
+             p99 vs the SLO, slo-burn fires) and grows or reaps replica
+             slots within `--replicas-min/--replicas-max`. Scale-up rides
+             the async spawn machinery; scale-down is DRAIN-BASED: the
+             victim is fenced out of `_pick_replica`, its queued batches
+             complete or reroute via the crash-reroute path, and only
+             then does the worker get the SIGTERM drain it already
+             honors — zero requests lost to a reap. Topology is
+             copy-on-write (`handles`/`_forwarders` dicts are REPLACED,
+             never mutated in place, under `_scale_lock`) so the hot
+             balancer/monitor iterations need no lock
   propagate  `/admin/{rollback,pin,unpin}` fan out to every replica, so a
              rollback freezes the WHOLE fleet, not one process. Hot
              reload needs no fan-out: each replica's own registry watcher
@@ -67,8 +79,11 @@ from ..batcher import (
     DeadlineExceeded,
     MicroBatcher,
     OverloadError,
+    ScoredRateWindow,
     ServeClosed,
+    retry_after_s,
 )
+from .autoscaler import maybe_autoscaler
 from .worker import ReplicaHandle, http_json, spawn_replica, stop_replica
 
 log = logging.getLogger("ytklearn_tpu.serve.fleet")
@@ -209,9 +224,29 @@ class FleetFront:
         forward_timeout_s: float = 60.0,
         log_dir: Optional[str] = None,
         slo_ms: Optional[float] = None,
+        replicas_min: Optional[int] = None,
+        replicas_max: Optional[int] = None,
+        autoscale: Optional[dict] = None,
     ):
         if replicas < 1:
             raise ValueError(f"fleet needs >= 1 replica, got {replicas}")
+        # autoscaling band: defaults collapse to a fixed fleet of
+        # `replicas` (max == min arms nothing — exact r14 semantics);
+        # the initial size is clamped into the band
+        self.replicas_min = int(replicas_min if replicas_min is not None
+                                else replicas)
+        # a floor above --replicas with no explicit ceiling means "start
+        # there": the ceiling follows the larger of the two
+        self.replicas_max = int(replicas_max if replicas_max is not None
+                                else max(replicas, self.replicas_min))
+        if self.replicas_min < 1:
+            raise ValueError(
+                f"replicas-min must be >= 1, got {self.replicas_min}")
+        if self.replicas_max < self.replicas_min:
+            raise ValueError(
+                f"replicas-max {self.replicas_max} < replicas-min "
+                f"{self.replicas_min}")
+        replicas = min(max(replicas, self.replicas_min), self.replicas_max)
         self.worker_argv = list(worker_argv)
         self.n_replicas = replicas
         self.policy = policy or BatchPolicy()
@@ -250,6 +285,20 @@ class FleetFront:
         # both sides hold one lock (ytklint unguarded-shared-write)
         self._respawns: Dict[int, threading.Thread] = {}
         self._respawns_lock = threading.Lock()
+        # topology writes (slot add/remove after start) are serialized
+        # here; `handles`/`_forwarders` are COPY-ON-WRITE — writers
+        # publish a NEW dict, so the balancer/monitor/metrics threads
+        # iterate their stable snapshot without taking any lock
+        self._scale_lock = threading.Lock()
+        # recent scored-rows/s (success path) -> the 429 Retry-After
+        # queue-drain estimate, and the autoscaler's throughput context
+        self._scored = ScoredRateWindow()
+        # load-driven autoscaler (autoscaler.py); armed in start() when
+        # the band is real (replicas_max > replicas_min)
+        self.autoscaler = maybe_autoscaler(
+            self, self.replicas_min, self.replicas_max, slo_ms=slo_ms,
+            params=autoscale,
+        )
         self.latency = None  # front-side client-visible ring, set in start()
         self.draining = False
         self._closing = False
@@ -273,7 +322,7 @@ class FleetFront:
                     self.worker_argv, rid, env=None, log_dir=self.log_dir,
                     ready_timeout_s=self.ready_timeout_s,
                 )
-                # ytklint: allow(unguarded-shared-write) reason=every _spawn thread is joined below before the monitor/balancer/listener exist; after start() the dict shape is frozen — dead slots heal IN PLACE via spawn_replica(handle=h)
+                # ytklint: allow(unguarded-shared-write) reason=every _spawn thread is joined below before the monitor/balancer/listener exist; after start() the dict is only ever REPLACED copy-on-write under _scale_lock (scale_up/_remove_slot) — dead slots heal IN PLACE via spawn_replica(handle=h)
                 self.handles[rid] = h
             except Exception as e:  # noqa: BLE001 — collected and re-raised below
                 errors[rid] = e
@@ -294,19 +343,25 @@ class FleetFront:
             raise RuntimeError(
                 f"fleet startup failed: replica {rid}: {err}"
             ) from err
-        for rid in range(self.n_replicas):
-            self._forwarders[rid] = MicroBatcher(
-                self._make_score_fn(rid), self.policy, trace_site="front"
-            )
-            with self._inflight_lock:
-                self._inflight[rid] = 0
+        with self._scale_lock:  # same discipline as the scale_up publisher
+            for rid in range(self.n_replicas):
+                self._forwarders[rid] = MicroBatcher(
+                    self._make_score_fn(rid), self.policy, trace_site="front"
+                )
+                with self._inflight_lock:
+                    self._inflight[rid] = 0
         self._monitor = threading.Thread(
             target=self._monitor_loop, name="ytk-fleet-monitor", daemon=True
         )
         self._monitor.start()
         if obs_enabled():
             start_history_sampler()  # /metrics?history=1 on the front
-        obs_gauge("serve.fleet.replicas", self.n_replicas)
+        # LIVE ready-slot gauge (not a set-once startup constant): every
+        # health/topology transition republishes it, so the metrics
+        # history plane renders crashes and scale ramps as a time series
+        self._publish_replica_gauge()
+        if self.autoscaler is not None:
+            self.autoscaler.start()
         log.info("fleet: %d replica(s) up: %s", self.n_replicas,
                  {rid: h.port for rid, h in sorted(self.handles.items())})
         return self
@@ -315,6 +370,10 @@ class FleetFront:
         self.draining = True
         self._closing = True
         self._stop_evt.set()
+        if self.autoscaler is not None:
+            # a tick mid-scale-down finishes its drain before exiting;
+            # scale_up threads ride _respawns and are joined below
+            self.autoscaler.stop(timeout=timeout + 30.0)
         if self._monitor is not None:
             self._monitor.join(timeout=10.0)
         # in-flight respawns see _closing (spawn abort + early h.proc
@@ -395,7 +454,12 @@ class FleetFront:
         (explicit `trace_ids` on the direct named-model path, else the
         forwarder's current batch) ride the X-Ytk-Trace header, so the
         replica adopts them and one trace id spans front -> replica."""
-        h = self.handles[rid]
+        h = self.handles.get(rid)
+        if h is None:
+            # the slot was scaled away between pick and POST: surface it
+            # as a connection-class loss so the caller's transient path
+            # reroutes — a KeyError here would masquerade as a 404
+            raise ConnectionResetError(f"replica {rid} was scaled away")
         ids = trace_ids or obs_trace.current_batch_ids()
         headers = {obs_trace.TRACE_HEADER: ",".join(ids)} if ids else None
         with self._inflight_lock:
@@ -414,7 +478,12 @@ class FleetFront:
                 )
         finally:
             with self._inflight_lock:
-                self._inflight[rid] = self._inflight.get(rid, 0) - len(rows)
+                # key-presence guard: a scale-down removes the slot only
+                # after this counter reads zero, but a named-model POST
+                # that picked the victim just before the fence must not
+                # resurrect the entry with a negative count
+                if rid in self._inflight:
+                    self._inflight[rid] -= len(rows)
         if status == 200:
             meta = {
                 "version": body.get("version"),
@@ -442,8 +511,8 @@ class FleetFront:
 
     def _make_score_fn(self, rid: int):
         def score_fn(rows):
-            h = self.handles[rid]
-            if h.state == "ready":
+            h = self.handles.get(rid)  # may be scaled away mid-drain
+            if h is not None and h.state == "ready":
                 try:
                     return self._post_predict(rid, rows)
                 except Exception as e:
@@ -473,9 +542,10 @@ class FleetFront:
             if not ready:
                 if cause is not None:
                     raise cause
+                gone = self.handles.get(exclude)
                 raise ServeClosed(
                     f"no ready replica to reroute to (replica {exclude} "
-                    f"is {self.handles[exclude].state})"
+                    f"is {gone.state if gone is not None else 'scaled away'})"
                 )
             rid = min(ready, key=self._load_of)
             tried.add(rid)
@@ -501,6 +571,7 @@ class FleetFront:
         if h is None or h.state != "ready":
             return
         h.state = "dead"
+        self._publish_replica_gauge()
         obs_inc("serve.worker.died")
         obs_event(
             "serve.worker.died", replica_id=rid, pid=h.pid,
@@ -517,13 +588,26 @@ class FleetFront:
         least-loaded ready replica's forwarder; returns the pending handle
         (serve_bench drives a bounded in-flight window through this).
         `trace` rides the pending handle into the forwarder (queue-wait
-        hop + batch-scoped forward hop + header propagation)."""
-        if self.draining:
-            raise ServeClosed("fleet front is draining")
-        rid = self._pick_replica()
-        return self._forwarders[rid].submit(
-            rows, deadline_ms=deadline_ms, trace=trace
-        )
+        hop + batch-scoped forward hop + header propagation).
+
+        A scale-down can fence the picked replica between the pick and
+        the forwarder call (its forwarder raises ServeClosed, or the slot
+        is already gone): the FLEET is not draining, so re-pick instead
+        of surfacing a spurious 503 — the zero-requests-lost reap
+        contract covers this window too."""
+        while True:
+            if self.draining:
+                raise ServeClosed("fleet front is draining")
+            rid = self._pick_replica()  # raises ServeClosed when none ready
+            f = self._forwarders.get(rid)
+            if f is None:
+                continue  # slot scaled away between pick and lookup
+            try:
+                return f.submit(rows, deadline_ms=deadline_ms, trace=trace)
+            except ServeClosed:
+                # the victim's forwarder closed under the scale-down
+                # fence; OverloadError (a real shed) propagates
+                continue
 
     def _request_done(self, ms: float) -> None:
         self.latency.record(ms)
@@ -616,6 +700,7 @@ class FleetFront:
             raise
         ms = (time.perf_counter() - t0) * 1e3
         self._request_done(ms)
+        self._scored.record(len(rows))  # drain-rate evidence for Retry-After
         obs_inc("serve.front.requests")
         obs_inc("serve.front.request_rows", len(rows))
         if own:
@@ -678,6 +763,12 @@ class FleetFront:
         import + ladder warmup, tens of seconds for a real worker) must
         not run on the monitor thread: while one replica respawns, the
         monitor has to keep detecting crashes/wedges on the others."""
+        if self.handles.get(rid) is not h:
+            # the slot was scaled away while this monitor pass held its
+            # pre-removal snapshot (stop_replica flips the reaped handle
+            # to "dead" at the end of its drain): a respawn here would be
+            # an ORPHAN worker no topology references — not ours to heal
+            return
         if time.monotonic() < self._restart_not_before.get(rid, 0.0):
             return
         h.state = "starting"  # monitor + balancer skip; no double spawn
@@ -720,6 +811,7 @@ class FleetFront:
             return
         self._strikes[rid] = 0
         self._restart_not_before.pop(rid, None)
+        self._publish_replica_gauge()
         obs_inc("serve.worker.restarted")
         obs_event(
             "serve.worker.restarted", replica_id=rid, pid=h.pid,
@@ -727,6 +819,158 @@ class FleetFront:
         )
         log.info("fleet: replica %d restarted (pid=%d port=%d, restart #%d)",
                  rid, h.pid, h.port, h.restarts)
+
+    # -- autoscaling (autoscaler.py drives these) --------------------------
+
+    def _publish_replica_gauge(self) -> None:
+        """serve.fleet.replicas tracks the LIVE ready-slot count — fed to
+        the metrics history plane so a ramp or a crash renders as a
+        sparkline, not a startup constant (r18 satellite)."""
+        obs_gauge("serve.fleet.replicas", len(self._ready_ids()))
+
+    def scale_up(self, reason: Optional[dict] = None) -> bool:
+        """Add one replica slot (async spawn — the jax warmup must not
+        block the caller, exactly like the crash-respawn path). The slot
+        is published "starting" immediately so it counts against
+        `replicas_max` and defers further decisions until it lands."""
+        with self._scale_lock:
+            if self._closing:
+                return False
+            if len(self.handles) >= self.replicas_max:
+                return False
+            rid = max(self.handles) + 1 if self.handles else 0
+            h = ReplicaHandle(rid)  # state "starting"
+            handles = dict(self.handles)
+            handles[rid] = h
+            forwarders = dict(self._forwarders)
+            forwarders[rid] = MicroBatcher(
+                self._make_score_fn(rid), self.policy, trace_site="front"
+            )
+            # publish copy-on-write: concurrent balancer/monitor passes
+            # keep iterating their old snapshot; the new slot appears
+            # atomically and stays unpicked until "ready"
+            self.handles = handles
+            self._forwarders = forwarders
+            with self._inflight_lock:
+                self._inflight[rid] = 0
+            t = threading.Thread(
+                target=self._do_scale_spawn, args=(rid, h, reason),
+                name=f"ytk-fleet-scale-up-{rid}", daemon=True,
+            )
+            with self._respawns_lock:
+                # same publish+start-under-lock discipline as
+                # _maybe_restart: stop() joins these threads
+                self._respawns[rid] = t
+                t.start()
+        log.info("fleet: scaling up -> slot %d spawning", rid)
+        return True
+
+    def _do_scale_spawn(self, rid: int, h: ReplicaHandle,
+                        reason: Optional[dict]) -> None:
+        try:
+            spawn_replica(
+                self.worker_argv, rid, handle=h, log_dir=self.log_dir,
+                ready_timeout_s=self.ready_timeout_s,
+                abort=lambda: self._closing,
+            )
+        except Exception as e:  # noqa: BLE001 — failed grow: slot removed, policy re-decides
+            obs_event(
+                "serve.scale.up_failed", replica_id=rid,
+                error=f"{type(e).__name__}: {e}"[:200],
+            )
+            log.error("fleet: scale-up spawn for slot %d failed (%s: %s)",
+                      rid, type(e).__name__, e)
+            self._remove_slot(rid, drain_forwarder=False)
+            return
+        if self._closing:
+            # fleet shut down while the new worker warmed: no orphans
+            stop_replica(h, timeout_s=10.0)
+            return
+        self._publish_replica_gauge()
+        obs_event("serve.scale.up_ready", replica_id=rid, pid=h.pid,
+                  port=h.port, replicas=len(self._ready_ids()))
+        log.info("fleet: scale-up complete — replica %d ready "
+                 "(pid=%s port=%d)", rid, h.pid, h.port)
+
+    def scale_down(self, reason: Optional[dict] = None,
+                   timeout: float = 30.0) -> Optional[int]:
+        """Reap one replica slot, DRAIN-BASED — zero requests lost:
+
+          1. fence: the victim (highest-rid ready slot) flips to
+             "draining", so `_pick_replica` stops routing to it and the
+             monitor ignores it (it only acts on ready/dead)
+          2. drain: its forwarder is closed with drain=True — batches
+             already POSTed complete normally, queued batches hit the
+             score_fn's not-ready branch and REROUTE to a sibling (the
+             crash-reroute path, minus the crash)
+          3. settle: wait for the in-HTTP-flight row count to reach zero
+             (a named-model POST that picked the victim pre-fence)
+          4. remove: the slot leaves the topology (copy-on-write), THEN
+             the worker gets the SIGTERM drain it already honors —
+             removed first, so the monitor can never see the corpse and
+             respawn it
+
+        Returns the reaped replica id, or None when nothing was safely
+        reapable (at min, last ready replica, or closing)."""
+        with self._scale_lock:
+            if self._closing:
+                return None
+            ready = sorted(self._ready_ids())
+            if len(ready) <= max(1, self.replicas_min):
+                return None
+            rid = ready[-1]
+            h = self.handles[rid]
+            h.state = "draining"  # the fence
+        self._publish_replica_gauge()
+        obs_event("serve.scale.drain", replica_id=rid, pid=h.pid,
+                  **(reason or {}))
+        f = self._forwarders.get(rid)
+        if f is not None:
+            f.close(drain=True, timeout=timeout)
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._inflight_lock:
+                left = self._inflight.get(rid, 0)
+            if left <= 0:
+                break
+            time.sleep(0.01)
+        self._remove_slot(rid, drain_forwarder=False)  # already drained
+        stop_replica(h, timeout_s=timeout, reason="scale_down")
+        obs_event("serve.scale.down_done", replica_id=rid,
+                  replicas=len(self._ready_ids()))
+        log.info("fleet: scale-down complete — replica %d drained and "
+                 "stopped", rid)
+        return rid
+
+    def _remove_slot(self, rid: int, drain_forwarder: bool) -> None:
+        """Take a slot out of the topology (copy-on-write republish)."""
+        with self._scale_lock:
+            handles = dict(self.handles)
+            handles.pop(rid, None)
+            forwarders = dict(self._forwarders)
+            f = forwarders.pop(rid, None)
+            self.handles = handles
+            self._forwarders = forwarders
+        with self._inflight_lock:
+            self._inflight.pop(rid, None)
+        # per-slot health state must not leak onto a future slot reusing
+        # this rid (scale-up allocates max(handles)+1, which can match a
+        # previously reaped id); the monitor only touches rids still in
+        # `handles`, so these pops cannot race a same-key write
+        self._strikes.pop(rid, None)
+        self._restart_not_before.pop(rid, None)
+        if f is not None:
+            # always release the forwarder's worker thread; drain=False on
+            # the failed-spawn path (nothing was ever routed there), and a
+            # second close after scale_down's drain is a no-op join
+            f.close(drain=drain_forwarder, timeout=10.0)
+        self._publish_replica_gauge()
+
+    def retry_after_s(self) -> int:
+        """429 Retry-After hint: fleet backlog ÷ recent scored-rows/s
+        (clamped) — how long the queues actually need to drain."""
+        backlog = sum(self._load_of(rid) for rid in self._ready_ids())
+        return retry_after_s(backlog, self._scored)
 
     # -- admin fan-out ----------------------------------------------------
 
@@ -845,6 +1089,14 @@ class FleetFront:
                 "ready": len(self._ready_ids()),
                 "restarts": total_restarts,
             },
+            # autoscaling state: bounds, thresholds, streaks, cooldown
+            # remainders, and the last executed decision (obs_report
+            # renders this block in the fleet table)
+            "autoscale": (
+                self.autoscaler.snapshot() if self.autoscaler is not None
+                else {"enabled": False, "min": self.replicas_min,
+                      "max": self.replicas_max}
+            ),
             # client-visible latency measured AT the front (queue + hop +
             # replica time) — the number an SLO dashboard should chart
             "latency": self.latency.percentiles() if self.latency else {},
@@ -923,11 +1175,14 @@ class FleetFront:
             def log_message(self, fmt, *args):
                 log.debug("front http: " + fmt, *args)
 
-            def _json(self, code: int, payload: dict) -> None:
+            def _json(self, code: int, payload: dict,
+                      headers: Optional[Dict[str, str]] = None) -> None:
                 body = json.dumps(payload).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -1018,9 +1273,10 @@ class FleetFront:
                 ctx.hop_at("front.parse", t_parse, time.perf_counter(),
                            rows=len(rows), raw_splice=raw_spliced)
 
-                def _reply(status: int, payload: dict) -> None:
+                def _reply(status: int, payload: dict,
+                           headers: Optional[Dict[str, str]] = None) -> None:
                     with ctx.hop("front.write", status=status):
-                        self._json(status, payload)
+                        self._json(status, payload, headers=headers)
                     obs_trace.finish(
                         ctx, status=status, rows=len(rows),
                         latency_ms=(time.perf_counter() - t_parse) * 1e3,
@@ -1034,7 +1290,12 @@ class FleetFront:
                             trace=ctx,
                         )
                     except OverloadError as e:
-                        _reply(429, {"error": str(e), "type": "overload"})
+                        # Retry-After: fleet backlog ÷ recent scored
+                        # rows/s, clamped — clients back off for the time
+                        # the queues actually need instead of hammering
+                        _reply(429, {"error": str(e), "type": "overload"},
+                               headers={"Retry-After":
+                                        str(front.retry_after_s())})
                         return
                     except DeadlineExceeded as e:
                         _reply(504, {"error": str(e), "type": "deadline"})

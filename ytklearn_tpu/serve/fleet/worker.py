@@ -52,7 +52,11 @@ class ReplicaHandle:
         self.replica_id = replica_id
         self.proc: Optional[subprocess.Popen] = None
         self.port: int = 0
-        #: starting | ready | dead | draining
+        #: starting | ready | dead | draining.  "draining" is the
+        #: scale-down fence too (front.scale_down): the balancer skips it
+        #: and the monitor ignores it (only ready/dead slots are acted
+        #: on), so a slot mid-reap can neither receive traffic nor be
+        #: "healed" back to life
         self.state = "starting"
         self.restarts = 0
         self.started_at = 0.0
@@ -240,8 +244,13 @@ def spawn_replica(
     return h
 
 
-def stop_replica(h: ReplicaHandle, timeout_s: float = 30.0) -> None:
-    """SIGTERM (the worker drains in-flight work), escalate to kill."""
+def stop_replica(h: ReplicaHandle, timeout_s: float = 30.0,
+                 reason: str = "shutdown") -> None:
+    """SIGTERM (the worker drains in-flight work), escalate to kill.
+    Fleet shutdown and autoscaler scale-down both end here: by the time
+    scale_down() calls this the slot is already fenced and its forwarder
+    drained, so the worker's own SIGTERM drain finds at most the batch
+    it is currently scoring — zero requests are lost to a reap."""
     h.state = "draining"
     proc = h.proc
     if proc is None or proc.poll() is not None:
@@ -252,8 +261,8 @@ def stop_replica(h: ReplicaHandle, timeout_s: float = 30.0) -> None:
         proc.wait(timeout=timeout_s)
     except subprocess.TimeoutExpired:
         log.warning(
-            "fleet: replica %d did not drain in %.0fs; killing",
-            h.replica_id, timeout_s,
+            "fleet: replica %d did not drain in %.0fs (%s); killing",
+            h.replica_id, timeout_s, reason,
         )
         proc.kill()
         proc.wait(timeout=10.0)

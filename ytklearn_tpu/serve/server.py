@@ -58,7 +58,9 @@ from .batcher import (
     DeadlineExceeded,
     MicroBatcher,
     OverloadError,
+    ScoredRateWindow,
     ServeClosed,
+    retry_after_s,
 )
 from .fleet.aimd import maybe_controller
 from .fleet.cache import maybe_cache
@@ -136,6 +138,9 @@ class ServeApp:
         if slo_ms and slo_ms > 0:
             obs_trace.configure_tracing(slo_ms=slo_ms)
         self.latency = _LatencyWindow()
+        # recent scored-rows/s (success path) -> the 429 Retry-After
+        # queue-drain estimate (same arithmetic as the fleet front)
+        self._scored = ScoredRateWindow()
         self.draining = False
         self._batchers: Dict[str, MicroBatcher] = {}
         self._batchers_lock = threading.Lock()
@@ -173,6 +178,15 @@ class ServeApp:
         self.latency.record(ms)
         if self.slo_burn is not None:
             self.slo_burn.observe(ms)
+
+    def retry_after_s(self) -> int:
+        """429 Retry-After hint: queued rows ÷ recent scored-rows/s
+        (clamped to a small bound) — how long the queue actually needs
+        to drain before a retry has a chance."""
+        with self._batchers_lock:
+            batchers = list(self._batchers.values())
+        backlog = sum(b.queued_rows for b in batchers)
+        return retry_after_s(backlog, self._scored)
 
     def _request_errored(self, status: int) -> None:
         """429/504 burned SLO budget without ever being scored; a 503
@@ -262,6 +276,9 @@ class ServeApp:
             raise
         ms = (time.perf_counter() - t0) * 1e3
         self._request_done(ms)
+        # scored-path completions only (a cache hit never drained the
+        # queue): the Retry-After estimate wants the queue's drain rate
+        self._scored.record(len(rows))
         obs_inc("serve.requests")
         obs_inc("serve.request_rows", len(rows))
         # version from the batch's own entry resolution — the response
@@ -367,11 +384,14 @@ class ServeApp:
             def log_message(self, fmt, *args):  # stderr spam -> logging
                 log.debug("http: " + fmt, *args)
 
-            def _json(self, code: int, payload: dict) -> None:
+            def _json(self, code: int, payload: dict,
+                      headers: Optional[Dict[str, str]] = None) -> None:
                 body = json.dumps(payload).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -469,9 +489,10 @@ class ServeApp:
                 ctx.hop_at("serve.parse", t_parse, time.perf_counter(),
                            rows=len(rows))
 
-                def _reply(status: int, payload: dict) -> None:
+                def _reply(status: int, payload: dict,
+                           headers: Optional[Dict[str, str]] = None) -> None:
                     with ctx.hop("serve.write", status=status):
-                        self._json(status, payload)
+                        self._json(status, payload, headers=headers)
                     obs_trace.finish(
                         ctx, status=status, rows=len(rows),
                         latency_ms=(time.perf_counter() - t_parse) * 1e3,
@@ -486,7 +507,11 @@ class ServeApp:
                             trace=ctx,
                         )
                     except OverloadError as e:
-                        _reply(429, {"error": str(e), "type": "overload"})
+                        # Retry-After: queue-drain estimate so a shed
+                        # client backs off intelligently (clamped)
+                        _reply(429, {"error": str(e), "type": "overload"},
+                               headers={"Retry-After":
+                                        str(app.retry_after_s())})
                         return
                     except DeadlineExceeded as e:
                         _reply(504, {"error": str(e), "type": "deadline"})
