@@ -36,6 +36,10 @@ tracing_overhead line is re-checked absolutely — 1%-head-sampled request
 tracing must stay within the throughput band of tracing-off. Artifacts
 predating the field skip cleanly.
 
+Quality-overhead gate: same shape for the model-quality plane's
+quality_overhead line (obs/quality.py row sampler at its default
+YTK_QUALITY_SAMPLE vs off); artifacts predating the field skip cleanly.
+
 Fleet gate: schema "serve_fleet" artifacts (schema_version 2,
 `serve_bench.py --fleet`) are a different workload — N replica processes
 — so they are compared ONLY against predecessors with the same metric
@@ -416,6 +420,47 @@ def check_tracing_overhead(
     return []
 
 
+def check_quality_overhead(
+    artifacts: List[Tuple[int, str]], tol: float
+) -> List[str]:
+    """Absolute gate on the NEWEST serve_rungs artifact's recorded
+    quality-overhead line (ISSUE 15): the model-quality row sampler at
+    its default rate must stay within the regress band of quality-off.
+    Artifacts predating the field (r17 and older) skip cleanly."""
+    import json
+
+    for rnd, path in reversed(artifacts):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if "parsed" in rec and "cmd" in rec:
+            rec = rec["parsed"] or {}
+        if rec.get("schema") != "serve_rungs":
+            continue
+        q = rec.get("quality_overhead") or {}
+        off = q.get("off_req_per_sec")
+        sampled = q.get("sampled_req_per_sec")
+        if not off or sampled is None:
+            print(f"  quality overhead: r{rnd} predates the field (skip)")
+            return []
+        floor = off * (1.0 - tol)
+        print(
+            f"  quality overhead (r{rnd}): sampled {sampled:.1f} vs off "
+            f"{off:.1f} req/s (floor {floor:.1f}, tol {tol:.0%})"
+        )
+        if sampled < floor:
+            return [
+                f"quality-sampler overhead out of band: {sampled:.1f} < "
+                f"{off:.1f} * (1 - {tol}) req/s in "
+                f"{os.path.basename(path)}"
+            ]
+        return []
+    print("  quality overhead: no serve_rungs artifact (skip)")
+    return []
+
+
 def check_fleet(old, new, tol: float) -> List[str]:
     """-> failure messages for the fleet pair (same replica count)."""
     (o_rnd, _o_path, o), (n_rnd, _n_path, n) = old, new
@@ -717,6 +762,7 @@ def main(argv=None) -> int:
             fails += check_serve(*pair, tol=args.tol)
     fails += check_rung_quality(serve_artifacts)
     fails += check_tracing_overhead(serve_artifacts, tol=args.tol)
+    fails += check_quality_overhead(serve_artifacts, tol=args.tol)
 
     fleet_pair = fleet_comparable_pair(serve_artifacts)
     if fleet_pair is None:

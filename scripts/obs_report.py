@@ -96,6 +96,15 @@ def _load(path: str) -> Tuple[str, dict]:
             "bench": None,
             "drill": doc,
         }
+    if doc.get("schema") == "drift_drill":
+        return "drift-drill", {
+            "events": [],
+            "counters": {},
+            "gauges": {},
+            "flight": None,
+            "bench": None,
+            "drift_drill": doc,
+        }
     if "flight" in doc:
         fl = doc["flight"]
         snap = fl.get("snapshot") or {}
@@ -149,10 +158,11 @@ def _load(path: str) -> Tuple[str, dict]:
             "bench": None,
             "fleet_metrics": doc,
             "history": doc.get("history"),
+            "quality": doc.get("quality"),
         }
     if "latency" in doc and "counters" in doc and "metric" not in doc:
         # a replica/solo ServeApp /metrics snapshot (?history=1 carries
-        # the per-metric time-series rings)
+        # the per-metric time-series rings, ?quality=1 the drift block)
         return "serve-metrics", {
             "events": [],
             "counters": doc.get("counters") or {},
@@ -160,6 +170,7 @@ def _load(path: str) -> Tuple[str, dict]:
             "flight": None,
             "bench": None,
             "history": doc.get("history"),
+            "quality": doc.get("quality"),
         }
     rec = doc.get("parsed") if ("parsed" in doc and "cmd" in doc) else doc
     rec = rec or {}
@@ -368,6 +379,86 @@ def render_history(hist: Optional[dict]) -> None:
             break
 
 
+# ---------------------------------------------------------------------------
+# Model-quality drift section (/metrics?quality=1 blocks)
+# ---------------------------------------------------------------------------
+
+
+def _quality_models(q: dict) -> Dict[str, dict]:
+    """Both shapes: a replica payload ({"models": ...}) and the fleet
+    front's merged payload ({"fleet": ...})."""
+    return dict(q.get("models") or q.get("fleet") or {})
+
+
+def _score_deciles(sj: Optional[dict]) -> Optional[List[float]]:
+    if not sj:
+        return None
+    from ytklearn_tpu.obs.quality import summary_from_json
+
+    s = summary_from_json(sj)
+    if s.size == 0:
+        return None
+    return [round(float(v), 4) for v in s.query_values(10)]
+
+
+def render_quality(q: Optional[dict]) -> None:
+    """Drift/calibration section: per-feature PSI table (worst first),
+    score-distribution comparison, and the missing-rate evidence — the
+    `/metrics?quality=1` block rendered for a postmortem."""
+    if not q:
+        return
+    models = _quality_models(q)
+    if not models:
+        return
+    _section("model quality (drift & calibration)")
+    if "sample" in q:
+        print(f"  sample rate: {q.get('sample')}  seed: {q.get('seed')}")
+    for key, m in sorted(models.items()):
+        if m.get("no_baseline"):
+            print(f"  {key}: NO BASELINE (quality.no_baseline) — "
+                  f"rows seen {m.get('rows_seen')}")
+            continue
+        print(f"  {key}: psi_max={m.get('psi_max')} "
+              f"ks_max={m.get('ks_max')} "
+              f"rows sampled {m.get('rows_sampled')}"
+              + (f" across {m['replicas']} replica(s)"
+                 if m.get("replicas") else ""))
+        worst = m.get("worst_features") or []
+        if worst:
+            print(f"  drifting most: {', '.join(worst)}")
+        feats = m.get("features") or {}
+        if feats:
+            print(f"  {'feature':<20s} {'psi':>8s} {'ks':>8s} "
+                  f"{'rows':>7s} {'missing':>8s}")
+            rows = sorted(
+                feats.items(), key=lambda kv: -(kv[1].get("psi") or 0.0)
+            )
+            for name, info in rows[:20]:
+                print(f"  {name:<20s} {str(info.get('psi', '-')):>8s} "
+                      f"{str(info.get('ks', '-')):>8s} "
+                      f"{str(info.get('rows', '-')):>7s} "
+                      f"{str(info.get('missing_rate', '-')):>8s}")
+            if len(rows) > 20:
+                print(f"  ... {len(rows) - 20} more feature(s)")
+        score = m.get("score") or {}
+        if score:
+            print(f"  score: mean_pred={score.get('mean_pred')} vs "
+                  f"baseline {score.get('baseline_mean')} "
+                  f"(delta {score.get('calibration_delta')}, "
+                  f"psi {score.get('psi')})")
+        base_d = _score_deciles(m.get("baseline_score"))
+        serve_d = _score_deciles(m.get("score_sketch"))
+        if base_d and serve_d:
+            print(f"  score deciles  base: {base_d}")
+            print(f"               serve: {serve_d}")
+    reps = q.get("replicas")
+    if isinstance(reps, dict) and reps:
+        for rid, per in sorted(reps.items()):
+            for key, c in sorted(per.items()):
+                print(f"  replica {rid} {key}: psi_max={c.get('psi_max')} "
+                      f"rows={c.get('rows_sampled')}")
+
+
 def report(path: str, perfetto: Optional[str] = None) -> None:
     kind, data = _load(path)
     counters, gauges, events = data["counters"], data["gauges"], data["events"]
@@ -409,6 +500,43 @@ def report(path: str, perfetto: Optional[str] = None) -> None:
                   "a summary; merge the drill's saved "
                   "trace_drill_traces.json snapshot instead",
                   file=sys.stderr)
+        return
+
+    dd = data.get("drift_drill")
+    if dd:
+        _section("drift drill (scripts/drift_drill.py)")
+        print(f"  ok: {dd.get('ok')}  {dd.get('replicas')} replicas, "
+              f"{dd.get('rounds')} rounds, PSI threshold "
+              f"{dd.get('psi_threshold')}")
+        steps = dd.get("steps") or {}
+        quiet = (steps.get("in_distribution") or {}).get("replicas") or {}
+        for rid, rep in sorted(quiet.items()):
+            print(f"  in-dist replica {rid}: psi_max={rep.get('psi_max')} "
+                  f"drift_fired={rep.get('drift_fired'):g}")
+        shifted = steps.get("shifted") or {}
+        print(f"  planted shift: {shifted.get('shift')}")
+        for rid, rep in sorted((shifted.get("replicas") or {}).items()):
+            print(f"  shifted replica {rid}: psi_max={rep.get('psi_max')} "
+                  f"worst={rep.get('worst_features')} "
+                  f"drift_fired={rep.get('drift_fired'):g} "
+                  f"retraces={rep.get('retraces'):g}")
+        fmerge = steps.get("fleet_merge") or {}
+        if fmerge:
+            print(f"  fleet merge: front psi_max="
+                  f"{fmerge.get('front_psi_max')} agrees="
+                  f"{fmerge.get('agrees')}")
+        flight = steps.get("flight") or {}
+        if flight:
+            print(f"  flight evidence: drift_fired="
+                  f"{flight.get('drift_fired'):g} in_dump="
+                  f"{flight.get('event_in_dump')}")
+        overhead = steps.get("overhead") or {}
+        if overhead:
+            print(f"  quality overhead: off {overhead.get('off_req_per_sec')}"
+                  f" / sampled {overhead.get('sampled_req_per_sec')} / "
+                  f"always {overhead.get('always_req_per_sec')} req/s")
+        for msg in dd.get("failures") or []:
+            print(f"  FAIL: {msg}")
         return
 
     fl = data["flight"]
@@ -682,6 +810,7 @@ def report(path: str, perfetto: Optional[str] = None) -> None:
               "exemplar rings (use an /admin/traces snapshot or a "
               "traced flight dump)", file=sys.stderr)
 
+    render_quality(data.get("quality"))
     render_history(data.get("history"))
 
     mem = _prefixed(gauges, "mem.")

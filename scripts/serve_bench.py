@@ -25,7 +25,11 @@ TRACING-OVERHEAD line: the default rung driven through the full
 ServeApp.predict path with request tracing off / head-sampled at 1% /
 always-on (`tracing_overhead` field; sampled must stay within the
 BENCH_REGRESS_TOL band of off — check_bench_regress re-gates the
-recorded artifact and skips artifacts predating the field).
+recorded artifact and skips artifacts predating the field), plus the
+QUALITY-OVERHEAD line (`quality_overhead`, ISSUE 15): the same harness
+with the model-quality row sampler (obs/quality.py) off / at the
+default YTK_QUALITY_SAMPLE / always-on, evaluator thread running —
+the default rate is gated inside the same band.
 
 Model: the agaricus GBDT demo (trained on the spot) when /root/reference
 is present, else a synthetic ensemble in the same format. Emits one
@@ -529,6 +533,82 @@ def measure_tracing_overhead(tmp_dir, trees, rows, seconds, log) -> dict:
         out["sampled_over_off"] = round(out["sampled_req_per_sec"] / off, 4)
         out["always_over_off"] = round(out["always_req_per_sec"] / off, 4)
     log.info("tracing overhead: %s", out)
+    return out
+
+
+def _ensure_quality_sidecar(tmp_dir, pred, rows) -> None:
+    """A quality baseline for the bench model: the reference-trained path
+    dumps one itself (gbdt/trainer.py); the synthetic hand-written model
+    gets one built from the request stream, so the overhead arms measure
+    the REAL sketching path, not the cheap no-baseline branch."""
+    from ytklearn_tpu.obs import quality as obs_quality
+
+    side = obs_quality.quality_sidecar_path(
+        os.path.join(tmp_dir, "gbdt.model"))
+    if os.path.exists(side):
+        return
+    names = sorted({nm for r in rows for nm in r})
+    X = np.full((len(rows), len(names)), np.nan)
+    col = {nm: j for j, nm in enumerate(names)}
+    for i, r in enumerate(rows):
+        for nm, v in r.items():
+            X[i, col[nm]] = float(v)
+    payload = obs_quality.build_training_sketch(
+        X, names, preds=np.asarray(pred.batch_predicts(rows[:512])),
+    )
+    obs_quality.dump_quality_sidecar(pred.fs, side, payload)
+
+
+def measure_quality_overhead(tmp_dir, pred, trees, rows, seconds, log) -> dict:
+    """The quality-plane overhead line (ISSUE 15): the default rung
+    driven through the full ServeApp.predict path with the model-quality
+    row sampler off / at the default rate / always-on, evaluator thread
+    running. Gated (main) so the default sample rate — what production
+    ships with — stays within the existing regress band of quality-off."""
+    from ytklearn_tpu.config import knobs as _knobs
+    from ytklearn_tpu.obs import quality as obs_quality
+    from ytklearn_tpu.serve import BatchPolicy, ModelRegistry, ServeApp
+    from ytklearn_tpu.serve.scorer import compile_credit
+
+    _ensure_quality_sidecar(tmp_dir, pred, rows)
+    default_rate = _knobs.KNOBS["YTK_QUALITY_SAMPLE"].default
+    cfg = {"model": {"data_path": os.path.join(tmp_dir, "gbdt.model")},
+           "optimization": {"loss_function": "sigmoid", "round_num": trees}}
+    reg = ModelRegistry(watch_interval_s=0)
+    with compile_credit():
+        reg.load("default", "gbdt", cfg)
+    app = ServeApp(reg, BatchPolicy(max_batch=512, max_wait_ms=1.0,
+                                    max_queue=1 << 15))
+    out = {"sample_rate": default_rate, "threads": 16}
+    obs_quality.start_quality_evaluator(interval_s=1.0)
+    try:
+        _drive_app_threads(app, rows, min(seconds, 1.0))  # warm the path
+        for label, rate in (("off", 0.0), ("sampled", default_rate),
+                            ("always", 1.0)):
+            obs_quality.configure_quality(sample=rate, seed=0, reset=True)
+            qps = _drive_app_threads(app, rows, seconds)
+            out[f"{label}_req_per_sec"] = round(qps, 1)
+            if label != "off":
+                snap = app.quality.evaluate(feed_sentinels=False)
+                out[f"{label}_rows_sampled"] = sum(
+                    int(m.get("rows_sampled") or 0) for m in snap.values()
+                )
+            log.info("quality overhead arm %-8s %8.0f req/s", label, qps)
+    finally:
+        obs_quality.stop_quality_evaluator()
+        # restore the env-configured plane for whatever runs next
+        obs_quality.configure_quality(
+            sample=_knobs.get_float("YTK_QUALITY_SAMPLE") or 0.0,
+            seed=_knobs.get_int("YTK_QUALITY_SEED") or 0, reset=True,
+        )
+        for b in app._batchers.values():
+            b.close(drain=True)
+        reg.close()
+    off = out.get("off_req_per_sec") or 0.0
+    if off > 0:
+        out["sampled_over_off"] = round(out["sampled_req_per_sec"] / off, 4)
+        out["always_over_off"] = round(out["always_req_per_sec"] / off, 4)
+    log.info("quality overhead: %s", out)
     return out
 
 
@@ -1328,6 +1408,10 @@ def main() -> int:
             tmp_dir, len(pred.model.trees), rows, args.seconds, log
         )
 
+        quality_overhead = measure_quality_overhead(
+            tmp_dir, pred, len(pred.model.trees), rows, args.seconds, log
+        )
+
         best = max(
             (r for r in rungs if r["rung"] != "default"),
             key=lambda r: r["req_per_sec"],
@@ -1365,6 +1449,7 @@ def main() -> int:
             "binned_quality": quality,
             "precision_bands": bands,
             "tracing_overhead": tracing,
+            "quality_overhead": quality_overhead,
             "data_source": source,
             "trees": len(pred.model.trees),
             "obs": {
@@ -1430,6 +1515,16 @@ def main() -> int:
                 f"sampled tracing overhead: {t_sam:.0f} req/s < "
                 f"{t_off:.0f} * (1 - {trace_tol}) with 1% head sampling "
                 "(env BENCH_REGRESS_TOL)"
+            )
+        # quality plane (ISSUE 15): the default sample rate must also
+        # stay inside the regress band of quality-off
+        q_off = quality_overhead.get("off_req_per_sec") or 0.0
+        q_sam = quality_overhead.get("sampled_req_per_sec") or 0.0
+        if q_off > 0 and q_sam < q_off * (1.0 - trace_tol):
+            fails.append(
+                f"quality-sampler overhead: {q_sam:.0f} req/s < "
+                f"{q_off:.0f} * (1 - {trace_tol}) at the default "
+                f"YTK_QUALITY_SAMPLE (env BENCH_REGRESS_TOL)"
             )
         if fleet_rec is not None and fleet_rec.get("retraces_fleet"):
             fails.append(

@@ -1,0 +1,700 @@
+"""Model-quality observability plane tests (ISSUE 15, obs/quality.py).
+
+Unit coverage for the deterministic counter-hashed row sampler, GK-summary
+PSI/KS distances (hand-computed pins), sketch mergeability (associativity
+pin: any merge order == single stream), the train-time sidecar round trip
+(+ the real GBDT trainer dumping it), the serve-side QualityMonitor with
+the health.drift / health.calibration sentinels, the missing-sidecar
+loud-but-non-fatal contract, the fleet merge, and the continual gate's
+recorded drift advisory.
+"""
+
+import itertools
+import json
+import math
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from serve_models import build_gbdt
+from test_serve import _load_prebuilt
+from ytklearn_tpu import obs
+from ytklearn_tpu.config import knobs
+from ytklearn_tpu.gbdt.quantile_sketch import Summary, merge_summaries
+from ytklearn_tpu.io.fs import LocalFileSystem
+from ytklearn_tpu.obs import health as obs_health
+from ytklearn_tpu.obs import quality as q
+from ytklearn_tpu.serve import BatchPolicy, ModelRegistry, ServeApp
+
+LADDER = (1, 4, 16)
+FS = LocalFileSystem()
+
+
+@pytest.fixture()
+def obs_on():
+    obs.configure(enabled=True)
+    obs.reset()
+    yield
+    obs.configure(enabled=False)
+    obs.reset()
+
+
+@pytest.fixture()
+def quality_on():
+    """Arm the default monitor at sample=1 with a fresh state; restore
+    the env default after (the ServeApp path uses the default monitor)."""
+    q.configure_quality(sample=1.0, seed=0, reset=True)
+    yield
+    q.stop_quality_evaluator()
+    q.configure_quality(
+        sample=knobs.get_float("YTK_QUALITY_SAMPLE") or 0.0,
+        seed=knobs.get_int("YTK_QUALITY_SEED") or 0, reset=True,
+    )
+
+
+def _rows_of(X, names):
+    return [{nm: float(v) for nm, v in zip(names, r)} for r in X]
+
+
+def _make_baseline(model_path, names, seed=0, n=4000, with_score=True):
+    """Hand-built sidecar: features ~ N(0,1), predictions ~ sigmoid of a
+    fixed teacher — the training distribution the tests replay/shift."""
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, len(names))
+    preds = 1.0 / (1.0 + np.exp(-X[:, 0])) if with_score else None
+    payload = q.build_training_sketch(X, names, preds=preds)
+    q.dump_quality_sidecar(FS, q.quality_sidecar_path(str(model_path)), payload)
+    return payload
+
+
+def _gbdt_app(tmp_path, baseline=True, **kw):
+    predictor, names = build_gbdt(tmp_path)
+    if baseline:
+        _make_baseline(tmp_path / "gbdt.model", names)
+    reg = ModelRegistry(ladder=LADDER, watch_interval_s=0)
+    _load_prebuilt(reg, "default", predictor)
+    app = ServeApp(reg, kw.pop("policy", BatchPolicy(max_batch=16,
+                                                     max_wait_ms=0.5)), **kw)
+    return app, names
+
+
+def _close(app):
+    for b in app._batchers.values():
+        b.close(drain=True)
+    app.registry.close()
+
+
+# ---------------------------------------------------------------------------
+# deterministic row sampler
+# ---------------------------------------------------------------------------
+
+
+def test_sampler_vectorized_matches_scalar_reference():
+    for seed in (0, 7, 12345):
+        for rate in (0.0, 0.25, 0.5, 1.0):
+            scalar = [q.row_keep(seed, n, rate) for n in range(1, 401)]
+            vec = q.sample_mask(seed, 0, 400, rate).tolist()
+            assert vec == scalar, (seed, rate)
+
+
+def test_sampler_reproduces_exactly_and_composes_across_requests():
+    whole = q.sample_mask(5, 0, 300, 0.3)
+    again = q.sample_mask(5, 0, 300, 0.3)
+    assert np.array_equal(whole, again)  # pure function of (seed, counter)
+    # request boundaries don't matter: the counter is the identity
+    parts = np.concatenate([
+        q.sample_mask(5, 0, 100, 0.3),
+        q.sample_mask(5, 100, 120, 0.3),
+        q.sample_mask(5, 220, 80, 0.3),
+    ])
+    assert np.array_equal(whole, parts)
+    other = q.sample_mask(6, 0, 300, 0.3)
+    assert not np.array_equal(whole, other)  # the seed matters
+    kept = int(np.count_nonzero(q.sample_mask(5, 0, 20000, 0.3)))
+    assert 5000 < kept < 7000  # ~rate, not all/none
+
+
+def test_sampler_rate_bounds():
+    assert q.sample_mask(0, 0, 50, 1.0).all()
+    assert not q.sample_mask(0, 0, 50, 0.0).any()
+    assert q.sample_mask(0, 0, 0, 0.5).shape == (0,)
+
+
+# ---------------------------------------------------------------------------
+# PSI / KS pins (hand-computed on tiny fixtures)
+# ---------------------------------------------------------------------------
+
+
+def test_psi_from_probs_hand_computed():
+    # psi([.5,.5] -> [.9,.1]) = .4*ln(1.8) - .4*ln(0.2)
+    want = 0.4 * math.log(0.9 / 0.5) + (0.1 - 0.5) * math.log(0.1 / 0.5)
+    assert abs(q.psi_from_probs([0.5, 0.5], [0.9, 0.1]) - want) < 1e-12
+    assert q.psi_from_probs([0.25] * 4, [0.25] * 4) == 0.0
+
+
+def test_ks_hand_computed():
+    a = Summary.from_exact(np.asarray([1.0, 2.0, 3.0, 4.0]))
+    b = Summary.from_exact(np.asarray([3.0, 4.0, 5.0, 6.0]))
+    # CDFs cross maximally at x in [2, 3): |0.5 - 0.0| = 0.5 exactly
+    assert q.ks_summaries(a, b) == 0.5
+    assert q.ks_summaries(a, a) == 0.0
+    c = Summary.from_exact(np.asarray([10.0, 11.0]))
+    assert q.ks_summaries(a, c) == 1.0  # disjoint supports
+
+
+def test_psi_summaries_identical_zero_shifted_large():
+    rng = np.random.RandomState(0)
+    base = Summary.from_exact(rng.randn(5000))
+    assert q.psi_summaries(base, base) == 0.0
+    same = Summary.from_exact(rng.randn(5000))
+    assert q.psi_summaries(base, same) < 0.05  # same distribution
+    shifted = Summary.from_exact(rng.randn(5000) + 3.0)
+    assert q.psi_summaries(base, shifted) > 2.0  # way past any threshold
+    assert q.psi_summaries(base, shifted) > q.psi_summaries(
+        base, Summary.from_exact(rng.randn(5000) + 0.5)
+    )  # monotone in the shift
+
+
+def test_summary_cdf_exact_on_unpruned():
+    s = Summary.from_exact(np.asarray([1.0, 2.0, 2.0, 3.0]))
+    np.testing.assert_allclose(
+        q.summary_cdf(s, [0.5, 1.0, 2.0, 2.5, 3.0, 9.0]),
+        [0.0, 0.25, 0.75, 0.75, 1.0, 1.0],
+    )
+
+
+# ---------------------------------------------------------------------------
+# mergeability: any order == single stream (the fleet-merge contract)
+# ---------------------------------------------------------------------------
+
+
+def test_merge_associativity_pin():
+    rng = np.random.RandomState(3)
+    parts = [Summary.from_exact(rng.randn(500 + 100 * i)) for i in range(4)]
+    ref = None
+    for perm in itertools.permutations(range(4)):
+        m = parts[perm[0]]
+        for i in perm[1:]:
+            m = merge_summaries(m, parts[i])
+        key = (tuple(m.value), tuple(m.rmin), tuple(m.rmax), tuple(m.w))
+        if ref is None:
+            ref = key
+        assert key == ref  # merge order cannot change the summary
+    single = Summary.from_exact(
+        np.concatenate([p.value for p in parts]),
+        np.concatenate([p.w for p in parts]),
+    )
+    # exact per-replica summaries merge to EXACTLY the single-stream
+    # summary: same values, same rank bounds, same quantile answers
+    assert np.array_equal(single.value, m.value)
+    assert np.array_equal(single.rmax, m.rmax)
+    assert np.array_equal(single.query_values(16), m.query_values(16))
+
+
+def test_merge_handles_mixed_no_baseline_replicas():
+    """Replicas can disagree on no_baseline for one key (one spawned
+    before the sidecar landed): the merge must degrade to the with-
+    baseline view, in either replica order — this was a KeyError that
+    took the fleet's /metrics?quality=1 down."""
+    rng = np.random.RandomState(2)
+    serve = Summary.from_exact(rng.randn(300))
+    with_base = {
+        "models": {
+            "m@v1": {
+                "model": "m", "version": 1, "no_baseline": False,
+                "rows_seen": 300, "rows_sampled": 300,
+                "psi_max": 0.0, "ks_max": 0.0,
+                "sketches": {"c0": q.summary_to_json(serve)},
+                "baseline": {"c0": q.summary_to_json(serve)},
+                "baseline_score": None, "baseline_score_mean": 0.5,
+                "score_sketch": q.summary_to_json(serve),
+                "score_sum": 1.0, "score_n": 300,
+            },
+        },
+    }
+    without = {"models": {"m@v1": {
+        "model": "m", "version": 1, "no_baseline": True,
+        "rows_seen": 50, "rows_sampled": 50,
+    }}}
+    for per in ({"0": without, "1": with_base},
+                {"0": with_base, "1": without}):
+        f = q.merge_quality_payloads(per)["fleet"]["m@v1"]
+        assert f["no_baseline"] is False
+        assert f["rows_sampled"] == 350  # both replicas' rows counted
+        assert f["features"]["c0"]["psi"] == 0.0
+    # all replicas baseline-less: still a clean no_baseline record
+    f = q.merge_quality_payloads({"0": without})["fleet"]["m@v1"]
+    assert f["no_baseline"] is True and f["rows_sampled"] == 50
+    assert "score_sum" not in f
+
+
+def test_drift_sentinel_fires_with_one_metric_none(obs_on):
+    """KS-only (or PSI-only) feeders exercise the documented Optional
+    contract: the fire message must not crash on the absent metric."""
+    s = obs_health.DriftSentinel("t", psi_threshold=0.25, ks_threshold=0.3,
+                                 windows=1, min_rows=1)
+    assert not s.observe(None, 0.9, rows=50)  # KS alone, psi=None
+    s2 = obs_health.DriftSentinel("t", psi_threshold=0.25, ks_threshold=0.3,
+                                  windows=1, min_rows=1)
+    assert not s2.observe(0.9, None, rows=50)  # PSI alone, ks=None
+    assert obs.snapshot()["counters"].get("health.drift") == 2
+
+
+def test_state_eviction_on_version_turnover(tmp_path, obs_on):
+    """A hot reload bumps the version: the retired version's state
+    (baseline + sketches + buffer) must not accumulate forever."""
+    predictor, names = build_gbdt(tmp_path)
+    _make_baseline(tmp_path / "gbdt.model", names)
+    mon = q.QualityMonitor(sample=1.0, seed=0)
+    rng = np.random.RandomState(0)
+    rows = _rows_of(rng.randn(4, len(names)), names)
+    preds = np.zeros(4)
+
+    class E:
+        name, fingerprint, predictor = "m", "fp", None
+
+    E.predictor = predictor
+    for version in (1, 2, 3):
+        E.version = version
+        mon.observe(E, rows, preds)
+    snap = mon.evaluate(feed_sentinels=False)
+    assert list(snap) == ["m@v3"]  # retired versions evicted
+    # a different model name is untouched by m's turnover
+    class E2(E):
+        name, version = "other", 1
+    mon.observe(E2, rows, preds)
+    E.version = 4
+    mon.observe(E, rows, preds)
+    assert sorted(mon.evaluate(feed_sentinels=False)) == ["m@v4", "other@v1"]
+
+
+def test_merge_quality_payloads_order_independent(tmp_path):
+    rng = np.random.RandomState(1)
+    base = Summary.from_exact(rng.randn(2000))
+
+    def replica_payload(seed, shift):
+        r = np.random.RandomState(seed)
+        serve = Summary.from_exact(r.randn(600) + shift)
+        return {
+            "models": {
+                "m@v1": {
+                    "model": "m", "version": 1, "rows_seen": 600,
+                    "rows_sampled": 600, "no_baseline": False,
+                    "psi_max": 0.0, "ks_max": 0.0,
+                    "sketches": {"c0": q.summary_to_json(serve)},
+                    "baseline": {"c0": q.summary_to_json(base)},
+                    "baseline_score": None, "baseline_score_mean": 0.5,
+                    "score_sketch": q.summary_to_json(serve),
+                    "score_sum": float(np.sum(serve.value * serve.w)),
+                    "score_n": 600,
+                },
+            },
+        }
+
+    a = replica_payload(10, 0.0)
+    b = replica_payload(11, 2.0)
+    m1 = q.merge_quality_payloads({"0": a, "1": b})
+    m2 = q.merge_quality_payloads({"1": b, "0": a})
+    assert m1["fleet"]["m@v1"]["features"] == m2["fleet"]["m@v1"]["features"]
+    assert m1["fleet"]["m@v1"]["psi_max"] == m2["fleet"]["m@v1"]["psi_max"]
+    assert m1["fleet"]["m@v1"]["rows_sampled"] == 1200
+    # fleet PSI == PSI of the directly merged serve summaries
+    merged = merge_summaries(
+        q.summary_from_json(a["models"]["m@v1"]["sketches"]["c0"]),
+        q.summary_from_json(b["models"]["m@v1"]["sketches"]["c0"]),
+    )
+    want = round(q.psi_summaries(base, merged), 4)
+    assert m1["fleet"]["m@v1"]["features"]["c0"]["psi"] == want
+
+
+# ---------------------------------------------------------------------------
+# sidecar: build / dump / load (+ the real trainer dump)
+# ---------------------------------------------------------------------------
+
+
+def test_sidecar_round_trip_and_digest(tmp_path):
+    names = ["a", "b"]
+    rng = np.random.RandomState(0)
+    X = rng.randn(500, 2)
+    X[::5, 1] = np.nan  # 20% missing on b
+    payload = q.build_training_sketch(X, names, preds=rng.rand(500))
+    path = str(tmp_path / "m.sketch.json")
+    q.dump_quality_sidecar(FS, path, payload, model_digest="abc")
+    base = q.load_quality_baseline(FS, path, model_digest="abc")
+    assert set(base["features"]) == {"a", "b"}
+    assert base["features"]["a"]["present"] == 1.0
+    assert abs(base["features"]["b"]["present"] - 0.8) < 1e-9
+    assert base["score"] is not None and 0.0 < base["score_mean"] < 1.0
+    # the sketch survives serialization exactly
+    s = base["features"]["a"]["summary"]
+    want = q.summary_from_json(payload["features"]["a"]["summary"])
+    assert np.array_equal(s.value, want.value)
+    # digest mismatch -> baseline-less (the crash-between-writes window)
+    assert q.load_quality_baseline(FS, path, model_digest="zzz") is None
+    # hand-built sidecars without a digest still load
+    q.dump_quality_sidecar(FS, path, payload)
+    assert q.load_quality_baseline(FS, path, model_digest="zzz") is not None
+    # missing / unreadable -> None, never a throw
+    assert q.load_quality_baseline(FS, str(tmp_path / "nope")) is None
+    (tmp_path / "rot.sketch.json").write_text("{not json")
+    assert q.load_quality_baseline(FS, str(tmp_path / "rot.sketch.json")) is None
+
+
+def test_trainer_dumps_quality_sidecar(tmp_path):
+    """The real GBDT trainer writes `<model>.sketch.json` with feature
+    summaries, presence, the held-out score block, and the model digest."""
+    from ytklearn_tpu.config.params import GBDTParams
+    from ytklearn_tpu.gbdt.binning import model_text_digest
+    from ytklearn_tpu.gbdt.data import GBDTIngest
+    from ytklearn_tpu.gbdt.trainer import GBDTTrainer
+
+    r = np.random.RandomState(1)
+
+    def write_rows(path, n, seed):
+        rr = np.random.RandomState(seed)
+        with open(path, "w") as f:
+            for _ in range(n):
+                x = rr.randn(3)
+                y = int(rr.rand() < 1 / (1 + math.exp(-x[0])))
+                f.write("1###%d###%s\n" % (
+                    y, ",".join(f"c{i}:{x[i]:.5f}" for i in range(3))))
+
+    write_rows(tmp_path / "train", 150, 1)
+    write_rows(tmp_path / "hold", 60, 2)
+    cfg = {
+        "data": {"train": {"data_path": str(tmp_path / "train")},
+                 "test": {"data_path": str(tmp_path / "hold")},
+                 "max_feature_dim": 3},
+        "model": {"data_path": str(tmp_path / "m.model")},
+        "loss": {"loss_function": "sigmoid"},
+        "optimization": {"round_num": 2, "max_depth": 2,
+                         "learning_rate": 0.3},
+    }
+    p = GBDTParams.from_config(cfg)
+    train, test = GBDTIngest(p).load()
+    GBDTTrainer(p).train(train=train, test=test)
+    side = str(tmp_path / "m.model.sketch.json")
+    doc = json.loads(open(side).read())
+    assert doc["schema"] == q.QUALITY_SCHEMA
+    assert set(doc["features"]) == {"c0", "c1", "c2"}
+    assert doc["score"]["n"] == 60  # the HELD-OUT rows, not train
+    assert doc["model_digest"] == model_text_digest(
+        open(tmp_path / "m.model").read()
+    )
+    base = q.load_quality_baseline(FS, side, model_digest=doc["model_digest"])
+    assert base is not None and len(base["features"]) == 3
+    _ = r  # fixture rng unused beyond seeding determinism
+
+
+# ---------------------------------------------------------------------------
+# sentinels
+# ---------------------------------------------------------------------------
+
+
+def test_drift_sentinel_windows_and_rearm(obs_on):
+    s = obs_health.DriftSentinel("t", psi_threshold=0.25, ks_threshold=0.35,
+                                 windows=2, min_rows=10)
+    assert s.observe(9.9, 9.9, rows=5)  # under min_rows: never judged
+    assert s.observe(0.9, 0.0, rows=100)  # first over-threshold tick
+    assert not s.observe(0.9, 0.0, rows=100)  # second consecutive -> fire
+    c = obs.snapshot()["counters"]
+    assert c.get("health.drift") == 1
+    assert c.get("health.drift.t") == 1
+    # a quiet tick resets the streak
+    assert s.observe(0.9, 0.0, rows=100)
+    assert s.observe(0.0, 0.0, rows=100)
+    assert s.observe(0.9, 0.0, rows=100)
+    assert not s.observe(0.9, 0.0, rows=100)  # re-armed after the fire
+    assert obs.snapshot()["counters"].get("health.drift") == 2
+    # KS alone trips too
+    s2 = obs_health.DriftSentinel("t2", psi_threshold=9.0, ks_threshold=0.3,
+                                  windows=1, min_rows=1)
+    assert not s2.observe(0.0, 0.9, rows=50)
+
+
+def test_calibration_sentinel(obs_on):
+    s = obs_health.CalibrationSentinel("t", tol=0.1, windows=2, min_rows=10)
+    assert s.observe(None, rows=100)  # no score baseline: never judged
+    assert s.observe(0.05, rows=100)
+    assert s.observe(0.3, rows=100)
+    assert not s.observe(0.3, rows=100)
+    assert obs.snapshot()["counters"].get("health.calibration") == 1
+
+
+def test_sentinels_noop_when_health_off(obs_on):
+    obs_health.configure_health(on=False)
+    try:
+        s = obs_health.DriftSentinel("t", windows=1, min_rows=1)
+        assert s.observe(99.0, 99.0, rows=1000)
+        assert "health.drift" not in obs.snapshot()["counters"]
+    finally:
+        obs_health.configure_health(on=True)
+
+
+# ---------------------------------------------------------------------------
+# serve-side monitor through ServeApp
+# ---------------------------------------------------------------------------
+
+
+def test_monitor_quiet_on_in_distribution_then_drifts(tmp_path, obs_on,
+                                                      quality_on):
+    app, names = _gbdt_app(tmp_path)
+    rng = np.random.RandomState(7)
+    try:
+        for _ in range(40):
+            app.predict(_rows_of(rng.randn(16, len(names)), names))
+        key = "default@v1"
+        m = app.quality.evaluate()[key]
+        assert not m["no_baseline"]
+        assert m["rows_sampled"] >= 600
+        assert m["psi_max"] < knobs.get_float("YTK_HEALTH_DRIFT_PSI")
+        assert "health.drift" not in obs.snapshot()["counters"]
+        # planted covariate shift on c0/c1 -> the sentinel names them
+        for _ in range(40):
+            X = rng.randn(16, len(names))
+            X[:, 0] += 4.0
+            X[:, 1] += 4.0
+            app.predict(_rows_of(X, names))
+        m1 = app.quality.evaluate()[key]
+        m2 = app.quality.evaluate()[key]  # 2 consecutive windows (default)
+        assert m2["psi_max"] > knobs.get_float("YTK_HEALTH_DRIFT_PSI")
+        assert {"c0", "c1"} & set(m2["worst_features"])
+        assert m2["features"]["c0"]["psi"] > 0.25
+        c = obs.snapshot()["counters"]
+        assert c.get("health.drift", 0) >= 1
+        ev = [e for e in obs.REGISTRY.events if e["name"] == "health.drift"]
+        assert ev and "c0" in ev[-1]["args"]["worst_features"]
+        assert ev[-1]["args"]["model"] == "default"
+        assert m1["psi_max"] > 0  # both judged windows saw the shift
+    finally:
+        _close(app)
+
+
+def test_monitor_scrape_does_not_advance_sentinel_windows(tmp_path, obs_on,
+                                                          quality_on):
+    """feed_sentinels=False (metrics scrapes) must not burn the
+    consecutive-window streak the evaluator owns."""
+    app, names = _gbdt_app(tmp_path)
+    rng = np.random.RandomState(7)
+    try:
+        for _ in range(30):
+            X = rng.randn(16, len(names)) + 4.0
+            app.predict(_rows_of(X, names))
+        for _ in range(5):  # scrapes galore: never a fire
+            app.quality.evaluate(feed_sentinels=False)
+        assert "health.drift" not in obs.snapshot()["counters"]
+        app.quality.evaluate()
+        app.quality.evaluate()
+        assert obs.snapshot()["counters"].get("health.drift", 0) >= 1
+    finally:
+        _close(app)
+
+
+def test_no_baseline_is_loud_but_non_fatal(tmp_path, obs_on, quality_on):
+    app, names = _gbdt_app(tmp_path, baseline=False)
+    rng = np.random.RandomState(7)
+    try:
+        out = app.predict(_rows_of(rng.randn(4, len(names)), names))
+        assert len(out["scores"]) == 4  # serving works
+        c = obs.snapshot()["counters"]
+        assert c.get("quality.no_baseline") == 1
+        app.predict(_rows_of(rng.randn(4, len(names)), names))
+        # counted once per (model, version), not per request
+        assert obs.snapshot()["counters"].get("quality.no_baseline") == 1
+        snap = app.quality.evaluate()
+        assert snap["default@v1"]["no_baseline"] is True
+        assert snap["default@v1"]["rows_seen"] == 8
+        assert "health.drift" not in obs.snapshot()["counters"]
+    finally:
+        _close(app)
+
+
+def test_metrics_quality_block_over_http(tmp_path, obs_on, quality_on):
+    app, names = _gbdt_app(tmp_path)
+    app.start()
+    rng = np.random.RandomState(7)
+    try:
+        for _ in range(10):
+            app.predict(_rows_of(rng.randn(8, len(names)), names))
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{app.port}/metrics?quality=1", timeout=10
+        ) as r:
+            doc = json.loads(r.read())
+        block = doc["quality"]
+        m = block["models"]["default@v1"]
+        assert m["rows_sampled"] >= 80
+        assert set(m["sketches"]) <= set(names)
+        assert set(m["baseline"]) == set(names)
+        # plain /metrics stays quality-free (the block is opt-in: it
+        # serializes sketches and runs an eval)
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{app.port}/metrics", timeout=10
+        ) as r:
+            assert "quality" not in json.loads(r.read())
+    finally:
+        app.stop(drain=True, timeout=10.0)
+
+
+def test_observe_sampling_is_deterministic(tmp_path, obs_on):
+    """The monitor's kept set reproduces exactly under a fixed seed —
+    request boundaries included (the drill contract)."""
+    predictor, names = build_gbdt(tmp_path)
+    _make_baseline(tmp_path / "gbdt.model", names)
+    rng = np.random.RandomState(0)
+    batches = [_rows_of(rng.randn(n, len(names)), names)
+               for n in (3, 7, 16, 1, 5)]
+    preds = [np.zeros(len(b)) for b in batches]
+
+    class E:  # minimal entry surface
+        name, version, fingerprint = "m", 1, "fp"
+        predictor = None
+
+    E.predictor = predictor
+    kept_runs = []
+    for _ in range(2):
+        mon = q.QualityMonitor(sample=0.5, seed=9)
+        kept = [mon.observe(E, b, p) for b, p in zip(batches, preds)]
+        kept_runs.append(kept)
+    assert kept_runs[0] == kept_runs[1]
+    total = sum(len(b) for b in batches)
+    want = [bool(v) for v in q.sample_mask(9, 0, total, 0.5)]
+    assert sum(kept_runs[0]) == sum(want)
+
+
+def test_quality_disabled_is_free(tmp_path, obs_on):
+    q.configure_quality(sample=0.0, reset=True)
+    app, names = _gbdt_app(tmp_path)
+    rng = np.random.RandomState(7)
+    try:
+        app.predict(_rows_of(rng.randn(4, len(names)), names))
+        assert app.quality.evaluate() == {}  # nothing tracked at all
+        assert not q.start_quality_evaluator()  # plane off: no thread
+    finally:
+        _close(app)
+        q.configure_quality(
+            sample=knobs.get_float("YTK_QUALITY_SAMPLE") or 0.0, reset=True
+        )
+
+
+# ---------------------------------------------------------------------------
+# threaded: concurrent observers + the evaluator thread (lockwatch twin)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.threaded("quality")
+def test_concurrent_observe_with_evaluator_thread(tmp_path, obs_on,
+                                                  quality_on):
+    app, names = _gbdt_app(tmp_path)
+    assert q.start_quality_evaluator(interval_s=0.05)
+    assert q.evaluator_running()
+    rng_seed = [0]
+    errors = []
+
+    def hammer(k):
+        rng = np.random.RandomState(100 + k)
+        try:
+            for _ in range(25):
+                app.predict(_rows_of(rng.randn(4, len(names)), names))
+        except Exception as e:  # noqa: BLE001 — the failure IS the finding
+            errors.append(f"{type(e).__name__}: {e}")
+
+    threads = [threading.Thread(target=hammer, args=(k,)) for k in range(4)]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60.0)
+        assert not errors, errors
+        q.stop_quality_evaluator()
+        assert not q.evaluator_running()
+        m = app.quality.evaluate(feed_sentinels=False)["default@v1"]
+        # row accounting is conserved across 4 writers + the evaluator
+        assert m["rows_seen"] == 4 * 25 * 4
+        assert m["rows_sampled"] == m["rows_seen"]  # sample=1.0
+        assert q.start_quality_evaluator(interval_s=0.05)  # restartable
+    finally:
+        q.stop_quality_evaluator()
+        _close(app)
+    _ = rng_seed
+
+
+# ---------------------------------------------------------------------------
+# plumbing: registry sidecar paths, continual roots, gate advisory
+# ---------------------------------------------------------------------------
+
+
+def test_sketch_sidecar_in_fingerprint_and_continual_roots(tmp_path):
+    from ytklearn_tpu.continual.driver import _roots
+    from ytklearn_tpu.serve.registry import _sidecar_paths, model_fingerprint
+
+    predictor, names = build_gbdt(tmp_path)
+    paths = _sidecar_paths(predictor)
+    assert str(tmp_path / "gbdt.model.sketch.json") in paths
+    roots = _roots("/m/model")
+    assert roots[".sketch.json"] == "/m/model.sketch.json"
+    # a sidecar-only change re-fingerprints the model (hot reload)
+    fp0 = model_fingerprint(predictor)
+    _make_baseline(tmp_path / "gbdt.model", names, n=500)
+    assert model_fingerprint(predictor) != fp0
+
+
+def test_gate_advisory_recorded_never_gating(obs_on):
+    from ytklearn_tpu.continual.driver import RetrainResult
+    from ytklearn_tpu.continual.gates import drift_advisory, evaluate_gates
+
+    payload = {
+        "models": {
+            "default@v3": {
+                "model": "default", "version": 3, "no_baseline": False,
+                "rows_sampled": 900, "psi_max": 1.4, "ks_max": 0.6,
+                "worst_features": ["c0", "c1"],
+                "score": {"calibration_delta": 0.21},
+            },
+            "other@v1": {"no_baseline": True, "rows_sampled": 10},
+        },
+    }
+    adv = drift_advisory(payload)
+    assert adv["psi_max"] == 1.4 and adv["worst_model"] == "default@v3"
+    assert adv["worst_features"] == ["c0", "c1"]
+    assert adv["calibration_delta"] == 0.21
+    assert adv["models_no_baseline"] == 1
+    # a screaming advisory NEVER fails the gate — advisory by contract
+    gate = evaluate_gates(0.5, 0.5, 0.0, {}, 100, advisory=adv)
+    assert gate.passed and gate.advisory == adv
+    out = RetrainResult(promoted=True, version=2, gate=gate).to_json()
+    assert out["gate"]["drift_advisory"]["psi_max"] == 1.4
+    # empty/absent quality blocks -> no advisory, no crash
+    assert drift_advisory(None) is None
+    assert drift_advisory({}) is None
+    assert drift_advisory({"models": {}}) is None
+    # the fleet-front merged shape works too
+    assert drift_advisory({"fleet": payload["models"]})["psi_max"] == 1.4
+
+
+def test_fetch_drift_advisory_from_live_server(tmp_path, obs_on, quality_on,
+                                               monkeypatch):
+    from ytklearn_tpu.continual.driver import _fetch_drift_advisory
+
+    monkeypatch.delenv("YTK_CONTINUAL_DRIFT_URL", raising=False)
+    assert _fetch_drift_advisory() is None  # knob unset: no fetch
+    app, names = _gbdt_app(tmp_path)
+    app.start()
+    rng = np.random.RandomState(7)
+    try:
+        for _ in range(10):
+            app.predict(_rows_of(rng.randn(8, len(names)), names))
+        monkeypatch.setenv("YTK_CONTINUAL_DRIFT_URL",
+                           f"http://127.0.0.1:{app.port}")
+        adv = _fetch_drift_advisory()
+        assert adv is not None and adv["rows_sampled"] >= 80
+        assert obs.snapshot()["counters"].get("continual.drift_advisory") == 1
+        # unreachable serving plane: advisory is None, never a throw
+        monkeypatch.setenv("YTK_CONTINUAL_DRIFT_URL",
+                           "http://127.0.0.1:1/")
+        assert _fetch_drift_advisory() is None
+        assert obs.snapshot()["counters"].get(
+            "continual.drift_advisory_failed") == 1
+    finally:
+        app.stop(drain=True, timeout=10.0)

@@ -90,7 +90,8 @@ def _rung_entry(rung, qps, p99, retraces=0, downgraded=False,
 
 
 def _rungs_artifact(tmp_path, rnd, rungs, metric="serve_req_per_sec_x_gbdt",
-                    binned_band=0.0, bf16=None, fleet=None, tracing=None):
+                    binned_band=0.0, bf16=None, fleet=None, tracing=None,
+                    quality_overhead=None):
     default = next(r for r in rungs if r["rung"] == "default")
     rec = {
         "schema_version": 3,
@@ -107,6 +108,8 @@ def _rungs_artifact(tmp_path, rnd, rungs, metric="serve_req_per_sec_x_gbdt",
         rec["fleet"] = fleet
     if tracing is not None:
         rec["tracing_overhead"] = tracing
+    if quality_overhead is not None:
+        rec["quality_overhead"] = quality_overhead
     (tmp_path / f"SERVE_r{rnd:02d}.json").write_text(json.dumps(rec))
 
 
@@ -181,6 +184,43 @@ def test_gate_passes_sampled_tracing_within_band(tmp_path, capsys):
     )
     assert gate_main(["--dir", str(tmp_path)]) == 0
     assert "tracing overhead (r17)" in capsys.readouterr().out
+
+
+def test_gate_skips_artifact_predating_quality_overhead(tmp_path, capsys):
+    """A serve_rungs artifact without the r19 quality_overhead field must
+    skip the quality-overhead gate cleanly (r16/r17 artifacts pass)."""
+    _rungs_artifact(tmp_path, 17, [_rung_entry("default", 10000.0, 20.0)],
+                    tracing={"off_req_per_sec": 10000.0,
+                             "sampled_req_per_sec": 9400.0})
+    assert gate_main(["--dir", str(tmp_path)]) == 0
+    assert "quality overhead: r17 predates the field (skip)" \
+        in capsys.readouterr().out
+
+
+def test_gate_fails_on_quality_overhead_out_of_band(tmp_path, capsys):
+    _rungs_artifact(
+        tmp_path, 19, [_rung_entry("default", 10000.0, 20.0)],
+        tracing={"off_req_per_sec": 10000.0, "sampled_req_per_sec": 9400.0},
+        quality_overhead={"off_req_per_sec": 10000.0,
+                          "sampled_req_per_sec": 7000.0,
+                          "always_req_per_sec": 5000.0,
+                          "sample_rate": 0.05},
+    )
+    assert gate_main(["--dir", str(tmp_path)]) == 1
+    assert "quality-sampler overhead out of band" in capsys.readouterr().err
+
+
+def test_gate_passes_quality_overhead_within_band(tmp_path, capsys):
+    _rungs_artifact(
+        tmp_path, 19, [_rung_entry("default", 10000.0, 20.0)],
+        tracing={"off_req_per_sec": 10000.0, "sampled_req_per_sec": 9400.0},
+        quality_overhead={"off_req_per_sec": 10000.0,
+                          "sampled_req_per_sec": 9300.0,
+                          "always_req_per_sec": 8000.0,
+                          "sample_rate": 0.05},
+    )
+    assert gate_main(["--dir", str(tmp_path)]) == 0
+    assert "quality overhead (r19)" in capsys.readouterr().out
 
 
 def _fleet_artifact(tmp_path, rnd, qps, p99, replicas=4,

@@ -154,6 +154,23 @@ _knob("YTK_OBS_HISTORY_N", "int", 256,
 _knob("YTK_OBS_HISTORY_S", "float", 1.0,
       "metrics-history sampling interval in seconds (the obs heartbeat "
       "sampler thread snapshots every counter/gauge this often)")
+_knob("YTK_QUALITY_SAMPLE", "float", 0.05,
+      "model-quality plane row-sample rate: the fraction of served rows "
+      "whose feature values and scores feed the per-model drift sketches "
+      "(deterministic counter-hashed draws; `0` disables the plane, `1` "
+      "= every row — see [observability.md](observability.md) "
+      "\"Model-quality plane\")")
+_knob("YTK_QUALITY_SEED", "int", 0,
+      "seed for the deterministic quality row sampler (same seed + same "
+      "row order = same sampled set)")
+_knob("YTK_QUALITY_B", "int", 64,
+      "entry budget per weighted-GK quality sketch (training sidecar and "
+      "serve-side streaming sketches; bounds both memory and the "
+      "/metrics?quality=1 export size)")
+_knob("YTK_QUALITY_EVAL_S", "float", 5.0,
+      "quality-evaluator tick interval in seconds: each tick drains the "
+      "sampled-row buffers into the sketches, recomputes PSI/KS and "
+      "calibration drift, and feeds the drift sentinels")
 
 # -- run health -------------------------------------------------------------
 _knob("YTK_HEALTH", "bool", True,
@@ -171,6 +188,25 @@ _knob("YTK_SLO_BURN_BUDGET", "float", 0.1,
       "SLO error budget as a windowed violation-rate fraction: when more "
       "than this fraction of a window's requests exceed the SLO (or are "
       "shed/504'd), `health.slo_burn` fires (strict mode escalates)")
+_knob("YTK_HEALTH_DRIFT_PSI", "float", 0.25,
+      "per-feature population-stability-index threshold for the serving "
+      "drift sentinel: consecutive quality-evaluator ticks with any "
+      "feature's PSI above it fire `health.drift` (0.1/0.25 are the "
+      "conventional watch/act levels)")
+_knob("YTK_HEALTH_DRIFT_KS", "float", 0.35,
+      "per-feature Kolmogorov-Smirnov distance threshold for the serving "
+      "drift sentinel (fires `health.drift` alongside the PSI test)")
+_knob("YTK_HEALTH_DRIFT_WINDOWS", "int", 2,
+      "consecutive over-threshold quality-evaluator ticks required before "
+      "`health.drift` / `health.calibration` fire (one noisy tick cannot "
+      "page anyone); the streak re-arms after each fire")
+_knob("YTK_HEALTH_DRIFT_MIN_ROWS", "int", 200,
+      "minimum sampled rows before the drift/calibration sentinels judge "
+      "a model (a two-request warmup is not a distribution)")
+_knob("YTK_HEALTH_CALIBRATION_TOL", "float", 0.1,
+      "calibration-drift tolerance: absolute |mean predicted score - "
+      "training-sidecar mean| (on the prediction scale) above which "
+      "`health.calibration` fires")
 _knob("YTK_FLIGHT", "bool", True,
       "flight-recorder auto-install in trainers; `0` opts out")
 _knob("YTK_FLIGHT_N", "int", 4096,
@@ -217,6 +253,12 @@ _knob("YTK_CONTINUAL_STRICT", "bool", False,
       "escalate a rejected retrain candidate to a non-zero exit "
       "(unattended freshness pipelines; default records the rejection "
       "and keeps the incumbent)")
+_knob("YTK_CONTINUAL_DRIFT_URL", "str", None,
+      "serving base URL (e.g. `http://127.0.0.1:8080`) the retrain "
+      "driver fetches `/metrics?quality=1` from: the serve-side drift "
+      "snapshot is recorded as an ADVISORY gate input (never pass/fail) "
+      "in the gate report and result JSON — the hook drift-gated "
+      "retraining hardens later")
 
 # -- serving ----------------------------------------------------------------
 _knob("YTK_SERVE_LADDER", "str", None,

@@ -55,7 +55,14 @@ from ..obs import (
 )
 from ..predict import create_predictor
 from ..resilience import chaos_point, retry_call
-from .gates import GateReport, evaluate_gates, health_counters, health_delta, holdout_loss
+from .gates import (
+    GateReport,
+    drift_advisory,
+    evaluate_gates,
+    health_counters,
+    health_delta,
+    holdout_loss,
+)
 
 log = logging.getLogger("ytklearn_tpu.continual")
 
@@ -111,6 +118,10 @@ class RetrainResult:
                 "band": self.gate.band,
                 "holdout_rows": self.gate.holdout_rows,
             }
+            if self.gate.advisory is not None:
+                # serve-side drift snapshot recorded at gate time —
+                # advisory by contract (docs/continual.md)
+                out["gate"]["drift_advisory"] = self.gate.advisory
         if self.trained:
             out["trained"] = {k: _finite(v) for k, v in self.trained.items()}
         return out
@@ -135,6 +146,10 @@ def _roots(data_path: str) -> Dict[str, str]:
         # promoted candidate must carry its own edges, and a rollback must
         # restore the incumbent's
         ".bins.json": data_path + ".bins.json",
+        # model-quality sketch sidecar (obs/quality.py): the drift
+        # baseline must travel with the exact ensemble it was built for
+        # through shadow/promote/archive/rollback
+        ".sketch.json": data_path + ".sketch.json",
     }
 
 
@@ -475,6 +490,38 @@ def _family(model_name: str) -> str:
     raise ValueError(f"unknown model name {model_name!r}")
 
 
+def _fetch_drift_advisory() -> Optional[dict]:
+    """Serve-side drift snapshot as a RECORDED advisory gate input:
+    `YTK_CONTINUAL_DRIFT_URL` names the serving front (or a replica) and
+    the driver scrapes its `/metrics?quality=1` at gate time. Never
+    fatal and never a gate reason — the freshness cycle must not depend
+    on the serving plane being scrapeable (the hook the ROADMAP's
+    drift-gated retraining hardens later)."""
+    url = knobs.get_str("YTK_CONTINUAL_DRIFT_URL")
+    if not url:
+        return None
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(
+            url.rstrip("/") + "/metrics?quality=1", timeout=10.0
+        ) as r:
+            doc = json.loads(r.read() or b"{}")
+    except Exception as e:  # noqa: BLE001 — advisory only, never the cycle
+        obs_inc("continual.drift_advisory_failed")
+        log.warning("drift advisory fetch from %s failed: %s: %s",
+                    url, type(e).__name__, e)
+        return None
+    adv = drift_advisory(doc.get("quality"))
+    if adv is not None:
+        obs_inc("continual.drift_advisory")
+        obs_event("continual.drift_advisory", **{
+            k: (",".join(map(str, v)) if isinstance(v, list) else v)
+            for k, v in adv.items()
+        })
+    return adv
+
+
 def _gbdt_incumbent_rounds(fs: FileSystem, p: GBDTParams) -> int:
     from ..gbdt.tree import GBDTModel
 
@@ -724,6 +771,7 @@ def _retrain_locked(
     health_hits.pop("health.retrace", None)
     gate = evaluate_gates(
         candidate_loss, incumbent_loss, band, health_hits, holdout_rows,
+        advisory=_fetch_drift_advisory(),
     )
 
     if not gate.passed:

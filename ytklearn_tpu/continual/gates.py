@@ -137,6 +137,51 @@ def holdout_loss(
     return loss, len(fmaps)
 
 
+def drift_advisory(quality_block: Optional[dict]) -> Optional[dict]:
+    """Compact the serving layer's `/metrics?quality=1` block into the
+    ADVISORY drift record the gate report carries: the worst PSI/KS and
+    calibration delta across served models, plus the offending features.
+    Advisory by contract — it is RECORDED next to the gate verdict (and
+    in the result JSON / `continual.drift_advisory` event) so a human or
+    a later drift-gated policy can act on it, but it never passes or
+    fails a candidate (ROADMAP: the hook drift-gated retraining
+    hardens)."""
+    if not quality_block:
+        return None
+    # replica payloads carry {"models": ...}; the fleet front's merged
+    # payload carries {"fleet": ...} — accept both
+    models = quality_block.get("models") or quality_block.get("fleet") or {}
+    if not models:
+        return None
+    out = {
+        "psi_max": 0.0,
+        "ks_max": 0.0,
+        "calibration_delta": None,
+        "worst_model": None,
+        "worst_features": [],
+        "rows_sampled": 0,
+        "models_no_baseline": 0,
+    }
+    for key, m in models.items():
+        if m.get("no_baseline"):
+            out["models_no_baseline"] += 1
+            continue
+        out["rows_sampled"] += int(m.get("rows_sampled") or 0)
+        psi = float(m.get("psi_max") or 0.0)
+        if psi >= out["psi_max"]:
+            out["psi_max"] = psi
+            out["worst_model"] = key
+            out["worst_features"] = list(m.get("worst_features") or [])
+        out["ks_max"] = max(out["ks_max"], float(m.get("ks_max") or 0.0))
+        cal = (m.get("score") or {}).get("calibration_delta")
+        if cal is not None:
+            prev = out["calibration_delta"]
+            out["calibration_delta"] = (
+                cal if prev is None else max(prev, float(cal))
+            )
+    return out
+
+
 @dataclass
 class GateReport:
     """Outcome of the promotion gates for one retrain candidate."""
@@ -148,6 +193,9 @@ class GateReport:
     band: float = 0.0
     holdout_rows: int = 0
     health: Dict[str, float] = field(default_factory=dict)
+    # serve-side drift snapshot at gate time (drift_advisory): recorded,
+    # never a pass/fail input
+    advisory: Optional[dict] = None
 
 
 def evaluate_gates(
@@ -156,10 +204,13 @@ def evaluate_gates(
     band: float,
     health_hits: Dict[str, float],
     holdout_rows: int = 0,
+    advisory: Optional[dict] = None,
 ) -> GateReport:
     """Combine the health + metric gates into one report. `None` losses
     mean "not measurable" (no held-out data / no incumbent): the metric
-    gate then passes vacuously — the health gate always applies."""
+    gate then passes vacuously — the health gate always applies.
+    `advisory` (the serve-side drift snapshot) is recorded verbatim and
+    never contributes a reason."""
     reasons: List[str] = []
     if health_hits:
         hits = ", ".join(f"{k}={v:g}" for k, v in sorted(health_hits.items()))
@@ -190,4 +241,5 @@ def evaluate_gates(
         band=band,
         holdout_rows=holdout_rows,
         health=dict(health_hits),
+        advisory=advisory,
     )

@@ -492,6 +492,7 @@ class GBDTTrainer:
         # sidecar (`<data_path>.bins.json`); edges are per ORIGINAL
         # feature, pre-EFB, like the dumped trees
         self._bins_sidecar = (list(train.feature_names or []), bins)
+        self._quality_features = self._build_quality_features(train)
         F_cols = plan.n_cols if plan is not None else F
         # mesh>1: the growth program runs under shard_map with each device
         # owning a contiguous feature slice of the histograms — pad the
@@ -1274,6 +1275,12 @@ class GBDTTrainer:
             model, bufs, bins, names, len(model.trees), trained_rounds * K
         )
         if not p.just_evaluate:
+            # held-out predictions (else train) feed the quality
+            # sidecar's score block before the final dump lands
+            if scores_t is not None:
+                self._stash_quality_scores(scores_t, w_t)
+            else:
+                self._stash_quality_scores(scores, weight)
             self._dump_model(model)
 
         eval_set = EvalSet(p.eval_metric, K=max(K, 2)) if p.eval_metric else None
@@ -1519,6 +1526,7 @@ class GBDTTrainer:
         log.info("building bins (%d features)...", F)
         bins = build_bins_global(train.X, train.weight, p, train.feature_names)
         self._bins_sidecar = (list(train.feature_names or []), bins)
+        self._quality_features = self._build_quality_features(train)
         B = bins.max_bins
         bins_np = bin_matrix(train.X, bins)
         bins_train = self._put(bins_np)
@@ -1682,6 +1690,10 @@ class GBDTTrainer:
             if p.model.dump_freq > 0 and (rnd + 1) % p.model.dump_freq == 0:
                 self._dump_model(model)
 
+        if test_state is not None:
+            self._stash_quality_scores(test_state[3], test_state[2])
+        else:
+            self._stash_quality_scores(scores, weight)
         self._dump_model(model)
         return self._finalize(
             model, scores, y, weight, test_state, eval_set, round_log, bins
@@ -1709,6 +1721,8 @@ class GBDTTrainer:
     _missing_fill: Optional[np.ndarray] = None
     _efb_plan = None  # BundlePlan when EFB merged columns this run
     _bins_sidecar = None  # (feature names, FeatureBins) for the serve sidecar
+    _quality_features = None  # `<model>.sketch.json` feature block (obs/quality)
+    _quality_scores = None  # held-out predictions for the sidecar score block
     _replay_bins = None  # transient pre-bundle matrices for warm-start replay
     _guard = None  # PreemptionGuard while train() runs (resilience/preempt.py)
 
@@ -1764,11 +1778,44 @@ class GBDTTrainer:
         depth = max(tree.max_depth(), 1)
         return _assign_kernel(bins_dev, feat, slot, left, right, depth)
 
+    def _build_quality_features(self, train) -> Optional[dict]:
+        """Feature block of the `<model>.sketch.json` quality sidecar
+        (obs/quality.py): per-feature GK summaries + presence rates of
+        the (real-row) training matrix, built once at binning time while
+        the host matrix is still alive."""
+        names = list(train.feature_names or [])
+        if not names:
+            return None
+        from ..obs.quality import build_training_sketch
+
+        n_real = getattr(train, "n_real", None) or train.X.shape[0]
+        with obs_span("gbdt.quality_sketch", features=len(names)):
+            return build_training_sketch(
+                np.asarray(train.X[:n_real]), names,
+                weight=np.asarray(train.weight[:n_real]),
+            )
+
+    def _stash_quality_scores(self, scores, weight) -> None:
+        """Score distribution for the quality sidecar: predictions of the
+        trained ensemble over the held-out set when one exists (else the
+        training rows), padded/zero-weight rows excluded."""
+        try:
+            preds = np.asarray(self.loss.predict(scores))
+            w = np.asarray(weight)[: preds.shape[0]]
+            self._quality_scores = preds[w > 0]
+        except Exception as e:  # noqa: BLE001 — sidecar evidence, never the run
+            log.warning("quality score stash failed (%s: %s); the sketch "
+                        "sidecar will carry no score block",
+                        type(e).__name__, e)
+
     def _dump_model(self, model: GBDTModel) -> None:
         if jax.process_index() != 0:
             return  # rank0-only dump (reference: GBDTOptimizer.java:434-437)
         p = self.params
         model_text = model.dumps(with_stats=True)
+        from .binning import model_text_digest
+
+        digest = model_text_digest(model_text)
         if self._bins_sidecar is not None:
             # bin-edge sidecar for serve-side binned scoring — written
             # BEFORE the model so a fingerprint-watch reload (triggered by
@@ -1776,17 +1823,34 @@ class GBDTTrainer:
             # embedded digest of the model text about to land lets serving
             # reject the new-edges/old-model pairing a crash between the
             # two writes would leave behind
-            from .binning import (
-                bin_edges_path, dump_bin_edges, model_text_digest,
-            )
+            from .binning import bin_edges_path, dump_bin_edges
 
             names, bins = self._bins_sidecar
             if len(names) == len(bins.counts):
                 dump_bin_edges(
                     self.fs, bin_edges_path(p.model.data_path), names, bins,
                     split_type=p.split_type,
-                    model_digest=model_text_digest(model_text),
+                    model_digest=digest,
                 )
+        if self._quality_features is not None:
+            # model-quality sidecar (`<model>.sketch.json`, obs/quality.py):
+            # per-feature training sketches + (once training finished) the
+            # held-out score distribution — written BEFORE the model like
+            # `.bins.json`, so a fingerprint-watch reload never pairs a
+            # fresh ensemble with a stale drift baseline
+            from ..obs.quality import (
+                build_score_block,
+                dump_quality_sidecar,
+                quality_sidecar_path,
+            )
+
+            payload = dict(self._quality_features)
+            if self._quality_scores is not None:
+                payload["score"] = build_score_block(self._quality_scores)
+            dump_quality_sidecar(
+                self.fs, quality_sidecar_path(p.model.data_path), payload,
+                model_digest=digest,
+            )
         # atomic write-then-replace: the serving registry hot-reloads this
         # file on a fingerprint watch, so a reader must never see a
         # half-written ensemble

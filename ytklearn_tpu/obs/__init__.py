@@ -36,6 +36,17 @@ Registry.history — docs/observability.md "Request tracing"):
                                      by the obs heartbeat thread, exported
                                      at /metrics?history=1;
                                      YTK_OBS_HISTORY_{N,S}
+
+Model-quality plane (obs/quality.py — docs/observability.md
+"Model-quality plane"):
+
+  quality                            train-time `<model>.sketch.json` GK
+                                     baselines, serve-side drift/
+                                     calibration monitor (deterministic
+                                     row sampler, PSI/KS, health.drift /
+                                     health.calibration sentinels),
+                                     fleet merge of per-replica sketches;
+                                     YTK_QUALITY_* / YTK_HEALTH_DRIFT_*
 """
 
 from .core import (  # noqa: F401

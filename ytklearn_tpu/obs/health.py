@@ -21,6 +21,15 @@ Sentinels (all fire `health.*` counters + an `obs.event`, and log):
                                  violation rate over the error budget
                                  fires `health.slo_burn`
                                  (YTK_SLO_BURN_{WINDOW,BUDGET})
+  DriftSentinel(site)            serving input drift: consecutive
+                                 quality-evaluator ticks with per-feature
+                                 PSI/KS over threshold fire `health.drift`
+                                 (YTK_HEALTH_DRIFT_{PSI,KS,WINDOWS,
+                                 MIN_ROWS}; obs/quality.py feeds it)
+  CalibrationSentinel(site)      mean predicted score vs the training
+                                 sidecar's score distribution fires
+                                 `health.calibration`
+                                 (YTK_HEALTH_CALIBRATION_TOL)
 
 Telemetry:
 
@@ -321,6 +330,153 @@ class SLOBurnSentinel:
             window=self.window,
             budget=self.budget,
             slo_ms=self.slo_ms,
+            **args,
+        )
+        return False
+
+
+class DriftSentinel:
+    """Input-drift alarm for the serving quality plane (obs/quality.py):
+    fed once per evaluator tick with the worst per-feature PSI and KS of
+    a served model versus its training sidecar. `windows` CONSECUTIVE
+    over-threshold ticks fire `health.drift` (counter + flight-ring
+    event naming the model and the offending features; strict mode
+    escalates like every sentinel), then the streak re-arms so a
+    sustained drift fires once per `windows` ticks, not per tick. Ticks
+    with fewer than `min_rows` sampled rows are never judged — a
+    two-request warmup is not a distribution.
+
+    Fed from ONE thread (the quality evaluator; metrics scrapes use
+    feed_sentinels=False), so the streak counter needs no lock.
+    """
+
+    __slots__ = ("site", "psi_threshold", "ks_threshold", "windows",
+                 "min_rows", "_over", "fired")
+
+    def __init__(
+        self,
+        site: str,
+        psi_threshold: Optional[float] = None,
+        ks_threshold: Optional[float] = None,
+        windows: Optional[int] = None,
+        min_rows: Optional[int] = None,
+    ):
+        self.site = site
+        self.psi_threshold = float(
+            psi_threshold if psi_threshold is not None
+            else knobs.get_float("YTK_HEALTH_DRIFT_PSI")
+        )
+        self.ks_threshold = float(
+            ks_threshold if ks_threshold is not None
+            else knobs.get_float("YTK_HEALTH_DRIFT_KS")
+        )
+        self.windows = max(1, int(
+            windows if windows is not None
+            else knobs.get_int("YTK_HEALTH_DRIFT_WINDOWS")
+        ))
+        self.min_rows = int(
+            min_rows if min_rows is not None
+            else knobs.get_int("YTK_HEALTH_DRIFT_MIN_ROWS")
+        )
+        self._over = 0
+        self.fired = 0
+
+    def observe(
+        self,
+        psi: Optional[float],
+        ks: Optional[float],
+        rows: int,
+        **args,
+    ) -> bool:
+        """Feed one evaluator tick. True = no drift alarm (or health off
+        / not enough rows yet)."""
+        if not _state.on:
+            return True
+        if rows < self.min_rows:
+            return True
+        over = (psi is not None and psi > self.psi_threshold) or (
+            ks is not None and ks > self.ks_threshold
+        )
+        if not over:
+            self._over = 0
+            return True
+        self._over += 1
+        if self._over < self.windows:
+            return True
+        self._over = 0  # re-arm
+        self.fired += 1
+        psi_txt = f"{psi:.3f}" if psi is not None else "n/a"
+        ks_txt = f"{ks:.3f}" if ks is not None else "n/a"
+        _fire(
+            "drift",
+            self.site,
+            f"input drift: PSI {psi_txt} (threshold "
+            f"{self.psi_threshold:g}) / KS {ks_txt} (threshold "
+            f"{self.ks_threshold:g}) over {rows} sampled rows",
+            psi=round(psi, 4) if psi is not None else None,
+            ks=round(ks, 4) if ks is not None else None,
+            rows=rows,
+            **args,
+        )
+        return False
+
+
+class CalibrationSentinel:
+    """Calibration-drift alarm: the mean predicted score/probability of
+    serving traffic versus the training sidecar's score distribution
+    (the McMahan calibration check, label-free). `windows` consecutive
+    evaluator ticks with |mean_pred - baseline_mean| above
+    `YTK_HEALTH_CALIBRATION_TOL` fire `health.calibration`, then
+    re-arm. Same single-feeder-thread contract as DriftSentinel."""
+
+    __slots__ = ("site", "tol", "windows", "min_rows", "_over", "fired")
+
+    def __init__(
+        self,
+        site: str,
+        tol: Optional[float] = None,
+        windows: Optional[int] = None,
+        min_rows: Optional[int] = None,
+    ):
+        self.site = site
+        self.tol = float(
+            tol if tol is not None
+            else knobs.get_float("YTK_HEALTH_CALIBRATION_TOL")
+        )
+        self.windows = max(1, int(
+            windows if windows is not None
+            else knobs.get_int("YTK_HEALTH_DRIFT_WINDOWS")
+        ))
+        self.min_rows = int(
+            min_rows if min_rows is not None
+            else knobs.get_int("YTK_HEALTH_DRIFT_MIN_ROWS")
+        )
+        self._over = 0
+        self.fired = 0
+
+    def observe(self, delta: Optional[float], rows: int, **args) -> bool:
+        """Feed one evaluator tick with the absolute mean-prediction
+        delta. True = calibration intact (or health off / warming up)."""
+        if not _state.on:
+            return True
+        if delta is None or rows < self.min_rows:
+            return True
+        if delta <= self.tol:
+            self._over = 0
+            return True
+        self._over += 1
+        if self._over < self.windows:
+            return True
+        self._over = 0  # re-arm
+        self.fired += 1
+        _fire(
+            "calibration",
+            self.site,
+            f"calibration drift: mean prediction off the training "
+            f"baseline by {delta:.4f} (tolerance {self.tol:g}) over "
+            f"{rows} sampled rows",
+            delta=round(delta, 6),
+            rows=rows,
             **args,
         )
         return False

@@ -1014,7 +1014,8 @@ class FleetFront:
             },
         }
 
-    def _scrape_replica(self, rid: int, h: ReplicaHandle) -> dict:
+    def _scrape_replica(self, rid: int, h: ReplicaHandle,
+                        quality: bool = False) -> dict:
         info = {
             "replica_id": rid,
             "pid": h.pid,
@@ -1025,9 +1026,12 @@ class FleetFront:
         }
         if h.state != "ready":
             return info
+        path = "/metrics?raw=1" + ("&quality=1" if quality else "")
         try:
-            status, m = http_json("GET", h.port, "/metrics?raw=1",
-                                  timeout=2.0)
+            # quality scrapes carry serialized sketches + run an eval on
+            # the replica — give them more room than the 2s liveness poll
+            status, m = http_json("GET", h.port, path,
+                                  timeout=10.0 if quality else 2.0)
         except OSError as e:
             info["scrape_error"] = f"{type(e).__name__}: {e}"[:120]
             return info
@@ -1039,14 +1043,18 @@ class FleetFront:
             info["batching"] = m.get("batching")
             if "cache" in m:
                 info["cache"] = m["cache"]
+            if quality and "quality" in m:
+                info["quality"] = m["quality"]
             counters = m.get("counters") or {}
             info["counters"] = {
                 k: v for k, v in counters.items()
-                if k.startswith(("serve.", "health.retrace", "chaos."))
+                if k.startswith(("serve.", "health.retrace", "health.drift",
+                                 "health.calibration", "quality.", "chaos."))
             }
         return info
 
-    def metrics_payload(self, history: bool = False) -> dict:
+    def metrics_payload(self, history: bool = False,
+                        quality: bool = False) -> dict:
         per: Dict[str, dict] = {}
         ring_union: List[float] = []
         now = time.time()
@@ -1058,7 +1066,7 @@ class FleetFront:
         results: Dict[int, dict] = {}
 
         def _scrape(rid, h):
-            results[rid] = self._scrape_replica(rid, h)
+            results[rid] = self._scrape_replica(rid, h, quality=quality)
 
         scrapers = [
             threading.Thread(target=_scrape, args=(rid, h), daemon=True)
@@ -1067,7 +1075,8 @@ class FleetFront:
         for t in scrapers:
             t.start()
         for t in scrapers:
-            t.join(timeout=5.0)
+            t.join(timeout=15.0 if quality else 5.0)
+        replica_quality: Dict[str, dict] = {}
         for rid, h in handles:
             total_restarts += h.restarts
             info = results.get(rid) or {
@@ -1081,6 +1090,9 @@ class FleetFront:
             ring_union.extend(
                 window_ring_ms(info.pop("raw_ms", None) or [], now)
             )
+            q = info.pop("quality", None)
+            if q:
+                replica_quality[str(rid)] = q
             per[str(rid)] = info
         snap = obs_snapshot()
         out = {
@@ -1116,6 +1128,14 @@ class FleetFront:
             # series); per-replica history lives at each replica's own
             # /metrics?history=1
             out["history"] = OBS_REGISTRY.history_snapshot() or {}
+        if quality:
+            # fleet drift view: every replica's serve-side GK summaries
+            # MERGE (obs/quality.merge_quality_payloads — mergeability is
+            # the whole point of the sketch), so fleet PSI/KS are
+            # computed over the union distribution, not averaged
+            from ...obs.quality import merge_quality_payloads
+
+            out["quality"] = merge_quality_payloads(replica_quality)
         return out
 
     def traces_payload(self) -> dict:
@@ -1200,7 +1220,9 @@ class FleetFront:
                                 else ("ok" if ok else "no ready replica")})
                 elif path == "/metrics":
                     hist = query.get("history", ["0"])[0] not in ("0", "")
-                    self._json(200, front.metrics_payload(history=hist))
+                    qual = query.get("quality", ["0"])[0] not in ("0", "")
+                    self._json(200, front.metrics_payload(
+                        history=hist, quality=qual))
                 elif path == "/admin/traces":
                     self._json(200, front.traces_payload())
                 else:

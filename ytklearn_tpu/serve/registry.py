@@ -68,6 +68,9 @@ def _sidecar_paths(predictor) -> list:
         # bin-edge sidecar for serve-side binned scoring: an edges-only
         # change must re-lower the scorer too (gbdt/binning.py)
         p.model.data_path + ".bins.json",
+        # model-quality sketch sidecar (obs/quality.py): a fresh drift
+        # baseline must reload with the model it was trained with
+        p.model.data_path + ".sketch.json",
     ]
     feature = getattr(p, "feature", None)
     if feature is not None and feature.transform.switch_on:

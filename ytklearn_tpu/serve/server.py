@@ -49,6 +49,7 @@ import numpy as np
 
 from ..obs import enabled as obs_enabled, inc as obs_inc, snapshot as obs_snapshot, span as obs_span
 from ..obs import health as obs_health
+from ..obs import quality as obs_quality
 from ..obs import trace as obs_trace
 from ..obs.core import REGISTRY as OBS_REGISTRY
 from ..obs.heartbeat import start_history_sampler
@@ -138,6 +139,11 @@ class ServeApp:
         if slo_ms and slo_ms > 0:
             obs_trace.configure_tracing(slo_ms=slo_ms)
         self.latency = _LatencyWindow()
+        # model-quality monitor (obs/quality.py): the predict path feeds
+        # sampled rows + predictions into per-model drift sketches; the
+        # evaluator thread (armed in start()) judges them against each
+        # model's training sidecar. YTK_QUALITY_SAMPLE=0 disables.
+        self.quality = obs_quality.default_monitor()
         # recent scored-rows/s (success path) -> the 429 Retry-After
         # queue-drain estimate (same arithmetic as the fleet front)
         self._scored = ScoredRateWindow()
@@ -194,6 +200,18 @@ class ServeApp:
         if self.slo_burn is not None and status in (429, 504):
             self.slo_burn.observe(violated=True)
 
+    def _observe_quality(self, entry, rows, preds) -> None:
+        """Feed the model-quality plane (drift sketches). Failures are
+        counted and logged — monitoring must never 500 a request."""
+        if not self.quality.enabled():
+            return
+        try:
+            self.quality.observe(entry, rows, preds)
+        except Exception as e:  # noqa: BLE001 — monitoring, never the request
+            obs_inc("quality.errors")
+            log.warning("quality observe failed: %s: %s",
+                        type(e).__name__, e)
+
     def predict(self, rows, model: Optional[str] = None,
                 deadline_ms: Optional[float] = None, timeout: float = 30.0,
                 trace=None):
@@ -231,6 +249,10 @@ class ServeApp:
                     self._request_done(ms)
                     obs_inc("serve.requests")
                     obs_inc("serve.request_rows", len(rows))
+                    preds_hit = np.asarray([h[1] for h in hit])
+                    # cache hits are served traffic: the drift sketches
+                    # must see the distribution clients actually send
+                    self._observe_quality(entry, rows, preds_hit)
                     if own:
                         obs_trace.finish(ctx, status=200, latency_ms=ms,
                                          rows=len(rows), cached=True)
@@ -239,7 +261,7 @@ class ServeApp:
                         "version": entry.version,
                         "cached": True,
                         "scores": np.asarray([h[0] for h in hit]).tolist(),
-                        "predictions": np.asarray([h[1] for h in hit]).tolist(),
+                        "predictions": preds_hit.tolist(),
                     }
             pending = self.batcher_for(name).submit(
                 rows, deadline_ms=deadline_ms, trace=ctx
@@ -285,6 +307,10 @@ class ServeApp:
         # must name the model that actually scored it, not whatever was
         # current at enqueue time (hot-reload race)
         entry = pending.meta or self.registry.get(name)
+        # quality plane: keyed by the entry that ACTUALLY scored the
+        # batch, like the cache below — a swap between submit and score
+        # must not attribute rows to the wrong version's sketches
+        self._observe_quality(entry, rows, preds)
         if cache is not None:
             # keyed by the entry that ACTUALLY scored the batch: a swap
             # landing between submit and score must not mislabel rows
@@ -324,7 +350,8 @@ class ServeApp:
             },
         }
 
-    def metrics_payload(self, raw: bool = False, history: bool = False) -> dict:
+    def metrics_payload(self, raw: bool = False, history: bool = False,
+                        quality: bool = False) -> dict:
         snap = obs_snapshot()
         with self._batchers_lock:  # batcher_for inserts concurrently
             batchers = dict(self._batchers)
@@ -371,6 +398,14 @@ class ServeApp:
             # sampled by the obs heartbeat thread (YTK_OBS_HISTORY_N) —
             # {} when the plane is off (obs disabled or N=0)
             out["history"] = OBS_REGISTRY.history_snapshot() or {}
+        if quality:
+            # model-quality plane: per-model drift/calibration metrics +
+            # the serialized serve-side GK sketches the fleet front
+            # merges (obs/quality.py; {} when YTK_QUALITY_SAMPLE=0)
+            out["quality"] = (
+                self.quality.snapshot(include_sketches=True)
+                if self.quality.enabled() else {}
+            )
         return out
 
     # -- lifecycle --------------------------------------------------------
@@ -444,7 +479,9 @@ class ServeApp:
                 elif path == "/metrics":
                     raw = query.get("raw", ["0"])[0] not in ("0", "")
                     hist = query.get("history", ["0"])[0] not in ("0", "")
-                    self._json(200, app.metrics_payload(raw=raw, history=hist))
+                    qual = query.get("quality", ["0"])[0] not in ("0", "")
+                    self._json(200, app.metrics_payload(
+                        raw=raw, history=hist, quality=qual))
                 elif path == "/admin/traces":
                     # the per-process exemplar ring: head-sampled + tail-
                     # retained request traces (obs/trace.py); obs_report
@@ -543,6 +580,9 @@ class ServeApp:
             # heartbeat thread; /metrics?history=1 exports them (no-op
             # when YTK_OBS_HISTORY_N=0)
             start_history_sampler()
+        # quality evaluator: periodic drift/calibration judgement against
+        # each model's training sidecar (no-op when YTK_QUALITY_SAMPLE=0)
+        obs_quality.start_quality_evaluator()
         log.info("serve: listening on %s:%d (%d model(s))",
                  self.host, self.port, len(self.registry))
         return self
