@@ -81,6 +81,7 @@ from __future__ import annotations
 
 import argparse
 import glob
+import json
 import os
 import re
 import sys
@@ -725,6 +726,95 @@ def check_goss(rnd: int, path: str, arms, tol_auc: float, min_speedup: float):
     return fails
 
 
+# ---------------------------------------------------------------------------
+# PROF (ytkprof drill) artifacts — compile-cost gate
+# ---------------------------------------------------------------------------
+
+
+def find_prof_artifacts(repo: str) -> List[Tuple[int, str]]:
+    """[(round, path)] sorted (PROF_r<NN>.json — scripts/prof_drill.py)."""
+    out = []
+    for path in glob.glob(os.path.join(repo, "PROF_*.json")):
+        m = re.search(r"PROF_r?(\d+)\.json$", os.path.basename(path))
+        if m:
+            out.append((int(m.group(1)), path))
+    return sorted(out)
+
+
+def _prof_identity(rec: dict) -> tuple:
+    """Comparable = same drill metric at the same workload shape — a
+    bigger drill in a later round must not gate against a smaller one."""
+    shape = rec.get("train", {}).get("shape", {})
+    return (rec.get("metric"), shape.get("rows"), shape.get("trees"))
+
+
+def prof_comparable_pair(artifacts: List[Tuple[int, str]]):
+    """(older, newest) ytkprof_drill records with matching identity, or
+    None. Unreadable / wrong-schema artifacts are skipped, not fatal."""
+    usable = []
+    for rnd, path in artifacts:
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except Exception as e:  # noqa: BLE001 — a rotten artifact is a skip
+            print(f"  [skip] {os.path.basename(path)}: unreadable ({e})")
+            continue
+        if rec.get("schema") != "ytkprof_drill":
+            print(f"  [skip] {os.path.basename(path)}: schema "
+                  f"{rec.get('schema')!r} is not ytkprof_drill")
+            continue
+        usable.append((rnd, path, rec))
+    if not usable:
+        return None, None
+    newest = usable[-1]
+    for older in reversed(usable[:-1]):
+        if _prof_identity(older[2]) == _prof_identity(newest[2]):
+            return older, newest
+    return None, newest
+
+
+def check_prof_absolute(newest) -> List[str]:
+    """Newest drill alone: steady-state retrace count must be zero (the
+    ladder/AOT contract — any post-warmup compile is a found bug)."""
+    rnd, path, rec = newest
+    fails = []
+    retraces = rec.get("retraces")
+    print(f"  prof retraces (r{rnd}): {retraces}")
+    if retraces != 0:
+        fails.append(
+            f"steady-state retraces in {os.path.basename(path)}: "
+            f"{retraces} != 0 (see the compile ledger entries in the "
+            "artifact — each names the program + signature diff)"
+        )
+    return fails
+
+
+def check_prof(old, new, tol: float) -> List[str]:
+    """Pair gate: total compile ms within band of the predecessor.
+    Compile time is jit-cache/machine sensitive, so the default band is
+    wide (PROF_COMPILE_TOL, fractional growth allowed)."""
+    (o_rnd, o_path, o), (n_rnd, n_path, n) = old, new
+    fails = []
+    o_ms = (o.get("compile") or {}).get("total_ms")
+    n_ms = (n.get("compile") or {}).get("total_ms")
+    if o_ms is None or n_ms is None:
+        print("  [skip] prof pair: artifact lacks compile.total_ms")
+        return fails
+    ceil = o_ms * (1.0 + tol)
+    print(
+        f"  compile cost: r{n_rnd} {n_ms:.0f} ms vs r{o_rnd} {o_ms:.0f} ms "
+        f"(ceiling {ceil:.0f} ms, tol {tol:.0%})"
+    )
+    if n_ms > ceil:
+        fails.append(
+            f"compile cost grew: {n_ms:.0f} ms > {o_ms:.0f} ms * "
+            f"(1 + {tol}) = {ceil:.0f} ms (per-program breakdown in "
+            f"{os.path.basename(n_path)} compile.by_program; "
+            "env PROF_COMPILE_TOL)"
+        )
+    return fails
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument(
@@ -803,6 +893,22 @@ def main(argv=None) -> int:
     if goss_arms is None:
         print("check_bench_regress: SKIP goss gate (no ablation artifact "
               "with goss + baseline arms)")
+
+    # compile-cost gate: newest ytkprof drill re-gated absolutely
+    # (retraces == 0), plus a compile-ms band vs a comparable predecessor
+    prof_artifacts = find_prof_artifacts(args.dir)
+    print(f"check_bench_regress: {len(prof_artifacts)} PROF artifact(s)")
+    prof_older, prof_newest = prof_comparable_pair(prof_artifacts)
+    if prof_newest is not None:
+        fails += check_prof_absolute(prof_newest)
+    if prof_older is None:
+        print("check_bench_regress: SKIP prof pair gate (fewer than two "
+              "comparable PROF artifacts)")
+    else:
+        fails += check_prof(
+            prof_older, prof_newest,
+            tol=float(os.environ.get("PROF_COMPILE_TOL", "0.75")),
+        )
 
     if fails:
         for f in fails:

@@ -105,6 +105,25 @@ def _load(path: str) -> Tuple[str, dict]:
             "bench": None,
             "drift_drill": doc,
         }
+    if doc.get("schema") == "ytkprof":
+        # a raw profiler.report() saved to a file
+        return "ytkprof", {
+            "events": [],
+            "counters": {},
+            "gauges": {},
+            "flight": None,
+            "bench": None,
+            "prof": doc,
+        }
+    if doc.get("schema") == "ytkprof_drill":
+        return "ytkprof-drill", {
+            "events": [],
+            "counters": {},
+            "gauges": {},
+            "flight": None,
+            "bench": None,
+            "prof_drill": doc,
+        }
     if "flight" in doc:
         fl = doc["flight"]
         snap = fl.get("snapshot") or {}
@@ -159,6 +178,7 @@ def _load(path: str) -> Tuple[str, dict]:
             "fleet_metrics": doc,
             "history": doc.get("history"),
             "quality": doc.get("quality"),
+            "prof": doc.get("prof"),
         }
     if "latency" in doc and "counters" in doc and "metric" not in doc:
         # a replica/solo ServeApp /metrics snapshot (?history=1 carries
@@ -171,6 +191,7 @@ def _load(path: str) -> Tuple[str, dict]:
             "bench": None,
             "history": doc.get("history"),
             "quality": doc.get("quality"),
+            "prof": doc.get("prof"),
         }
     rec = doc.get("parsed") if ("parsed" in doc and "cmd" in doc) else doc
     rec = rec or {}
@@ -459,6 +480,88 @@ def render_quality(q: Optional[dict]) -> None:
                       f"rows={c.get('rows_sampled')}")
 
 
+def render_prof(rep: dict) -> None:
+    """Render a `ytkprof` report dict (obs/profiler.report()): the phase
+    wall-time accountant, compile ledger, device kernel table, and
+    phase-attributed memory watermarks."""
+    phases = rep.get("phases") or {}
+    if phases:
+        _section("profiled phases (wall time)")
+        for name, p in phases.items():
+            pad = "  " * p.get("depth", 0)
+            print(f"  {pad + name:<32s} {p.get('wall_s', 0):9.3f} s  "
+                  f"x{p.get('count', 0)}")
+        if rep.get("wall_s") is not None:
+            print(f"  wall {rep['wall_s']:.3f}s  phase coverage "
+                  f"{100.0 * (rep.get('phase_coverage') or 0):.1f}%")
+    comp = rep.get("compile") or {}
+    if comp.get("compiles"):
+        _section("compile ledger")
+        print(f"  compiles: {comp['compiles']}  total: "
+              f"{comp.get('total_ms', 0):.1f} ms")
+        for name, v in (comp.get("by_program") or {}).items():
+            print(f"  {name:<32s} {v.get('compiles', 0):>3d} compile(s) "
+                  f"{v.get('ms', 0):>9.1f} ms")
+        # retraces carry the caught signature diff — the named culprit
+        for e in comp.get("entries") or []:
+            if e.get("changed"):
+                print(f"  retrace {e.get('program')} ({e.get('ms', 0):.1f} "
+                      f"ms): {'; '.join(e['changed'])}")
+    kern = rep.get("kernels") or {}
+    if kern.get("top_kernels"):
+        _section("device time (trace captures)")
+        print(f"  captures: {kern.get('parsed', 0)}/{kern.get('captures', 0)}"
+              f" parsed  device total: {kern.get('device_total_ms', 0):.1f}"
+              " ms")
+        for name, ms in sorted(
+            (kern.get("span_device_ms") or {}).items(), key=lambda kv: -kv[1]
+        ):
+            print(f"  span {name:<27s} {ms:>9.2f} ms")
+        print(f"  {'top kernel':<32s} {'ms':>9s} {'calls':>7s} {'share':>7s}")
+        for k in kern["top_kernels"]:
+            print(f"  {k.get('name', '?')[:32]:<32s} {k.get('ms', 0):>9.2f} "
+                  f"{k.get('count', 0):>7d} "
+                  f"{100.0 * (k.get('share') or 0):>6.1f}%")
+    peaks = (rep.get("mem") or {}).get("phase_peaks") or {}
+    if peaks:
+        _section("memory peaks by phase")
+        for ph, v in peaks.items():
+            bits = [
+                f"{label} {_fmt_bytes(v[key])}"
+                for key, label in (("device_peak_bytes", "device"),
+                                   ("host_rss_peak_bytes", "rss"))
+                if key in v
+            ]
+            print(f"  {ph:<32s} {'  '.join(bits)}")
+
+
+def render_serve_prof(prof: dict) -> None:
+    """Render the `prof` block of a /metrics?prof=1 snapshot: per-rung
+    kernel-time attribution for each served model, plus the process's
+    compile ledger."""
+    _section("serve profiling (?prof=1)")
+    print(f"  profiler enabled: {prof.get('enabled')}")
+    for mname, snap in sorted((prof.get("models") or {}).items()):
+        print(f"  model {mname}: mode={snap.get('mode')} "
+              f"backend={snap.get('backend')} ladder={snap.get('ladder')}")
+        rungs = snap.get("rungs") or {}
+        if rungs:
+            print(f"    {'rung':>6s} {'calls':>7s} {'rows':>9s} "
+                  f"{'exec s':>9s} {'ms/row':>8s}")
+            for rung, rs in sorted(rungs.items(),
+                                   key=lambda kv: int(kv[0])):
+                print(f"    {rung:>6s} {rs.get('calls', 0):>7d} "
+                      f"{rs.get('rows', 0):>9d} {rs.get('exec_s', 0):>9.3f} "
+                      f"{rs.get('ms_per_row', 0):>8.4f}")
+    comp = prof.get("compile") or {}
+    if comp.get("compiles"):
+        print(f"  compiles: {comp['compiles']}  total: "
+              f"{comp.get('total_ms', 0):.1f} ms")
+        for name, v in (comp.get("by_program") or {}).items():
+            print(f"    {name:<30s} {v.get('compiles', 0):>3d} compile(s) "
+                  f"{v.get('ms', 0):>9.1f} ms")
+
+
 def report(path: str, perfetto: Optional[str] = None) -> None:
     kind, data = _load(path)
     counters, gauges, events = data["counters"], data["gauges"], data["events"]
@@ -539,6 +642,32 @@ def report(path: str, perfetto: Optional[str] = None) -> None:
             print(f"  FAIL: {msg}")
         return
 
+    pd = data.get("prof_drill")
+    if pd:
+        _section("profiling drill (scripts/prof_drill.py)")
+        shape = (pd.get("train") or {}).get("shape") or {}
+        print(f"  ok: {pd.get('ok')}  metric: {pd.get('metric')} = "
+              f"{pd.get('value')}")
+        print(f"  train: {shape.get('rows')} rows x "
+              f"{shape.get('features')} features, {shape.get('trees')} "
+              f"trees  wall {pd.get('wall_s')}s")
+        print(f"  steady-state retraces: {pd.get('retraces'):g}")
+        srv = pd.get("serve") or {}
+        if srv:
+            print(f"  serve leg: {srv.get('requests')} requests over "
+                  f"{len(srv.get('rungs') or {})} rung(s), prof block "
+                  f"present: {srv.get('prof_block')}")
+        for msg in pd.get("failures") or []:
+            print(f"  FAIL: {msg}")
+        if pd.get("prof"):
+            render_prof(pd["prof"])
+        return
+
+    prof_rep = data.get("prof")
+    if kind == "ytkprof":
+        render_prof(prof_rep or {})
+        return
+
     fl = data["flight"]
     if fl:
         print(f"reason: {fl.get('reason')}   wall_time: {fl.get('wall_time')}")
@@ -557,6 +686,15 @@ def report(path: str, perfetto: Optional[str] = None) -> None:
         print(
             f"ring: {len(events)} events (capacity {fl.get('ring_capacity')})"
         )
+        fprof = fl.get("prof")
+        if fprof:
+            # the flight-dump prof block is a compact ytkprof subset —
+            # lift mem_phase_peaks back into report shape and reuse
+            render_prof({
+                "phases": fprof.get("phases"),
+                "compile": fprof.get("compile"),
+                "mem": {"phase_peaks": fprof.get("mem_phase_peaks")},
+            })
 
     bench = data["bench"]
     if bench:
@@ -809,6 +947,9 @@ def report(path: str, perfetto: Optional[str] = None) -> None:
         print("note: --perfetto ignored — this artifact carries no "
               "exemplar rings (use an /admin/traces snapshot or a "
               "traced flight dump)", file=sys.stderr)
+
+    if prof_rep and kind in ("serve-metrics", "fleet-metrics"):
+        render_serve_prof(prof_rep)
 
     render_quality(data.get("quality"))
     render_history(data.get("history"))

@@ -1,0 +1,265 @@
+"""Profiling drill: prove the ytkprof plane (obs/profiler.py) end to end.
+
+Runs a REAL CPU GBDT training pass with the profiler armed and writes
+one PROF_rNN.json artifact (schema ytkprof_drill, checked in like
+TRACE_r17/DRIFT_r18) recording the evidence the ISSUE 20 acceptance
+asks for:
+
+  train    phase accountant must decompose >=90% of the training wall
+           time into named depth-0 buckets (gbdt.load / preprocess /
+           compile / train / finalize); the per-phase trace capture
+           must parse into a non-empty top-k kernel table with device
+           time attributed to named spans; the compile ledger must
+           record every jit program with per-program cost; the memory
+           sampler must attribute watermarks to the phase they peaked
+           under
+  serve    the dumped model served in-process across batch rungs:
+           metrics_payload(prof=True) must carry per-rung kernel-time
+           attribution and the process compile ledger
+  steady   post-warmup retraces must be zero — any retrace would name
+           its culprit program + signature diff in the ledger, and
+           scripts/check_bench_regress.py fails the artifact
+
+check_bench_regress.py additionally gates the newest two comparable
+artifacts (same metric + workload shape) on compile.total_ms growth
+(env PROF_COMPILE_TOL).
+
+Usage: python scripts/prof_drill.py [--record PROF_r20.json]
+       [--rows 40000] [--trees 10]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+import numpy as np  # noqa: E402
+
+log = logging.getLogger("prof_drill")
+COVERAGE_FLOOR = 0.9
+
+
+def _mk_data(n: int, n_features: int, seed: int):
+    from ytklearn_tpu.gbdt.data import GBDTData
+
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, n_features).astype(np.float32)
+    logit = (
+        1.5 * X[:, 0] * X[:, 1]
+        + np.sin(X[:, 2] * 2)
+        + 0.8 * (X[:, 3] > 0.5)
+        - 0.5 * X[:, 4] ** 2
+    )
+    y = (logit + rng.randn(n) * 0.5 > 0).astype(np.float32)
+    return GBDTData(
+        X=X, y=y, weight=np.ones(n, np.float32), n_real=n,
+        feature_names=[f"f{i}" for i in range(n_features)],
+    )
+
+
+def train_step(args, tmp_dir: str, model_path: str) -> dict:
+    """Profiled training pass. The drill deliberately does NOT wrap the
+    call in an outer phase: the trainer's own gbdt.* phases must cover
+    the wall time at depth 0 — that IS the decomposition claim."""
+    from ytklearn_tpu.config.params import (
+        ApproximateSpec, GBDTParams, ModelParams,
+    )
+    from ytklearn_tpu.gbdt.trainer import GBDTTrainer
+    from ytklearn_tpu.obs import profiler
+
+    params = GBDTParams(
+        round_num=args.trees,
+        max_depth=6,
+        max_leaf_cnt=63,
+        tree_grow_policy="loss",
+        learning_rate=0.1,
+        min_child_hessian_sum=50.0,
+        loss_function="sigmoid",
+        eval_metric=[],
+        watch_train=False,
+        watch_test=False,
+        approximate=[ApproximateSpec(max_cnt=255)],
+        model=ModelParams(data_path=model_path, dump_freq=0),
+    )
+    data = _mk_data(args.rows, args.features, seed=0)
+    trainer = GBDTTrainer(params)
+    t0 = time.perf_counter()
+    res = trainer.train(train=data, test=None)
+    wall = time.perf_counter() - t0
+    rep = profiler.report(wall_s=wall)
+    return {
+        "trees_built": len(res.model.trees),
+        "train_loss": round(res.train_loss, 5),
+        "wall_s": round(wall, 3),
+        "report": rep,
+    }
+
+
+def serve_step(args, model_path: str) -> dict:
+    """Serve the just-dumped model in-process and pull the ?prof=1
+    payload: per-rung attribution + the ledger, post-warmup."""
+    from ytklearn_tpu import obs
+    from ytklearn_tpu.serve import BatchPolicy, ModelRegistry, ServeApp
+    from ytklearn_tpu.serve.scorer import compile_credit
+
+    cfg = {"model": {"data_path": model_path},
+           "optimization": {"loss_function": "sigmoid",
+                            "round_num": args.trees}}
+    reg = ModelRegistry(watch_interval_s=0)
+    with compile_credit():
+        reg.load("default", "gbdt", cfg)
+    app = ServeApp(reg, BatchPolicy(max_batch=64, max_wait_ms=0.2))
+    rng = np.random.RandomState(3)
+    retrace_before = obs.snapshot()["counters"].get("health.retrace", 0.0)
+    try:
+        # small singles and near-full batches land on different ladder
+        # rungs — the attribution table must keep them apart
+        for _ in range(24):
+            app.predict(
+                [{f"f{j}": float(rng.randn()) for j in range(args.features)}],
+                timeout=60.0,
+            )
+        for _ in range(6):
+            rows = [
+                {f"f{j}": float(rng.randn()) for j in range(args.features)}
+                for _ in range(48)
+            ]
+            app.predict(rows, timeout=60.0)
+        m = app.metrics_payload(prof=True)
+        prof = m.get("prof") or {}
+        rungs = ((prof.get("models") or {}).get("default") or {}).get(
+            "rungs"
+        ) or {}
+        retrace_after = obs.snapshot()["counters"].get(
+            "health.retrace", 0.0
+        )
+        return {
+            "requests": 30,
+            "prof_block": bool(prof),
+            "prof_enabled": prof.get("enabled"),
+            "rungs": rungs,
+            "ledger_compiles": (prof.get("compile") or {}).get("compiles"),
+            "retraces_during_serve": retrace_after - retrace_before,
+        }
+    finally:
+        for b in app._batchers.values():
+            b.close(drain=True)
+        reg.close()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--record", default="PROF_r20.json")
+    ap.add_argument("--rows", type=int, default=40000)
+    ap.add_argument("--trees", type=int, default=10)
+    ap.add_argument("--features", type=int, default=20)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO, stream=sys.stderr,
+                        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+
+    from ytklearn_tpu import obs
+    from ytklearn_tpu.obs import profiler
+
+    fails = []
+    with tempfile.TemporaryDirectory() as tmp_dir:
+        # arm the whole plane: phases, jax annotations, trace capture
+        # into the tempdir, ledger, fast memory sampling (the drill run
+        # is short — the default 0.5 s tick would miss early phases)
+        profiler.configure_profiler(
+            on=True, capture_dir=os.path.join(tmp_dir, "prof"),
+            mem_interval=0.05,
+        )
+        model_path = os.path.join(tmp_dir, "gbdt.model")
+
+        log.info("== step 1: profiled training (%d rows x %d trees) ==",
+                 args.rows, args.trees)
+        tr = train_step(args, tmp_dir, model_path)
+        rep = tr["report"]
+        coverage = rep.get("phase_coverage") or 0.0
+        log.info("wall %.2fs coverage %.1f%% compiles %s device %.1f ms",
+                 tr["wall_s"], 100 * coverage,
+                 (rep.get("compile") or {}).get("compiles"),
+                 (rep.get("kernels") or {}).get("device_total_ms", 0.0))
+        if coverage < COVERAGE_FLOOR:
+            fails.append(
+                f"phase coverage {100 * coverage:.1f}% of "
+                f"{tr['wall_s']}s wall is below the "
+                f"{100 * COVERAGE_FLOOR:.0f}% floor (phases: "
+                f"{list((rep.get('phases') or {}))})"
+            )
+        if not (rep.get("kernels") or {}).get("top_kernels"):
+            fails.append("trace capture produced no kernel table")
+        if not (rep.get("compile") or {}).get("compiles"):
+            fails.append("compile ledger recorded no programs")
+        if not (rep.get("mem") or {}).get("phase_peaks"):
+            fails.append("memory sampler attributed no phase peaks")
+
+        log.info("== step 2: serve the dumped model (?prof=1) ==")
+        srv = serve_step(args, model_path)
+        if not srv.get("prof_block"):
+            fails.append("metrics_payload(prof=True) carried no prof block")
+        if not srv.get("rungs"):
+            fails.append("serve prof block has no per-rung attribution")
+        if srv.get("retraces_during_serve"):
+            fails.append(
+                f"{srv['retraces_during_serve']:g} retrace(s) during the "
+                "serve leg"
+            )
+
+        retraces = obs.snapshot()["counters"].get("health.retrace", 0.0)
+        if retraces:
+            fails.append(f"steady-state retraces: {retraces:g} != 0")
+
+        out = {
+            "schema": "ytkprof_drill",
+            "schema_version": 1,
+            "metric": "phase_coverage",
+            "value": round(coverage, 4),
+            "unit": "fraction",
+            "train": {
+                "shape": {
+                    "rows": args.rows,
+                    "features": args.features,
+                    "trees": args.trees,
+                },
+                "trees_built": tr["trees_built"],
+                "train_loss": tr["train_loss"],
+            },
+            "wall_s": tr["wall_s"],
+            "phase_coverage": round(coverage, 4),
+            "compile": {
+                "compiles": (rep.get("compile") or {}).get("compiles"),
+                "total_ms": (rep.get("compile") or {}).get("total_ms"),
+                "by_program": (rep.get("compile") or {}).get("by_program"),
+            },
+            "retraces": retraces,
+            "serve": srv,
+            "prof": rep,
+            "failures": fails,
+            "ok": not fails,
+        }
+        profiler.configure_profiler(on=False)
+
+    print(json.dumps({k: out[k] for k in
+                      ("schema", "metric", "value", "wall_s", "retraces",
+                       "ok", "failures")}), flush=True)
+    print(profiler.format_report(rep), flush=True)
+    if args.record:
+        with open(args.record, "w") as f:
+            json.dump(out, f, indent=1)
+    for msg in fails:
+        log.error("FAIL: %s", msg)
+    return 1 if fails else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -9,7 +9,6 @@ from __future__ import annotations
 import logging
 import os
 import sys
-import time
 
 import numpy as np
 
@@ -64,10 +63,15 @@ def main() -> None:
         approximate=[ApproximateSpec(max_cnt=255)],
         model=ModelParams(data_path="/tmp/profile_engine_model", dump_freq=0),
     )
-    t0 = time.time()
+    # timing rides the ytkprof plane (obs/profiler.py) — the same phase
+    # accountant production runs use, not a second ad-hoc stopwatch
+    from ytklearn_tpu.obs import profiler
+
+    profiler.configure_profiler(on=True)
     trainer = GBDTTrainer(params, engine="device", wave=wave, hist_precision=prec)
-    res = trainer.train(train=train, test=test)
-    dt = time.time() - t0
+    with profiler.phase("profile.run"):
+        res = trainer.train(train=train, test=test)
+    dt = profiler.phases_snapshot()["profile.run"]["wall_s"]
     nb = len(res.model.trees)
     print(
         f"policy={policy} wave={wave} prec={prec} rows={n} trees={nb} total={dt:.1f}s "
@@ -78,6 +82,7 @@ def main() -> None:
     depths = [t.max_depth() for t in res.model.trees]
     print(f"tree nodes min/med/max: {min(sizes)}/{sorted(sizes)[len(sizes)//2]}/{max(sizes)}"
           f"  depth max: {max(depths)}")
+    print(profiler.format_report(profiler.report(wall_s=dt)))
 
 
 if __name__ == "__main__":

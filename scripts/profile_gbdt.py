@@ -9,10 +9,12 @@ Usage: python scripts/profile_gbdt.py [n_rows] [n_trees] [policy]
 
 from __future__ import annotations
 
+import os
 import sys
-import time
 
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main() -> None:
@@ -58,10 +60,15 @@ def main() -> None:
         feature_names=[f"f{i}" for i in range(F)],
     )
 
+    # timing rides the ytkprof plane (obs/profiler.py) — the same phase
+    # accountant production runs use, not a second ad-hoc stopwatch
+    from ytklearn_tpu.obs import profiler
+
+    profiler.configure_profiler(on=True)
     trainer = GBDTTrainer(params)
-    t0 = time.time()
-    res = trainer.train(train=data, test=None)
-    dt = time.time() - t0
+    with profiler.phase("profile.run"):
+        res = trainer.train(train=data, test=None)
+    dt = profiler.phases_snapshot()["profile.run"]["wall_s"]
     n_built = len(res.model.trees)
     print(
         f"policy={policy} rows={n} trees={n_built} total={dt:.1f}s "
@@ -70,6 +77,7 @@ def main() -> None:
     for rec in res.round_log:
         if "elapsed" in rec:
             print(f"  round {rec['round']}: cum {rec['elapsed']:.1f}s")
+    print(profiler.format_report(profiler.report(wall_s=dt)))
 
 
 if __name__ == "__main__":

@@ -106,6 +106,20 @@ def _flush_trace(trace_out: str) -> None:
         obs.flush()
 
 
+def _setup_profile(profile: Optional[str]) -> None:
+    """--profile [DIR]: arm the ytkprof profiling plane (phase accounting,
+    compile ledger, memory-watermark sampler); with DIR, also capture
+    jax.profiler traces per phase into it (YTK_PROF everywhere else)."""
+    if profile is None:
+        return
+    from .obs import profiler
+
+    if profile:
+        profiler.configure_profiler(on=True, capture_dir=profile)
+    else:
+        profiler.configure_profiler(on=True)
+
+
 def train_main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="ytklearn-tpu-train",
@@ -140,10 +154,17 @@ def train_main(argv: Optional[List[str]] = None) -> int:
                     help="write a Chrome-trace/Perfetto JSON of the run to "
                     "this path (YTK_TRACE=path everywhere else; see "
                     "docs/observability.md)")
+    ap.add_argument("--profile", nargs="?", const="", default=None,
+                    metavar="DIR",
+                    help="arm the ytkprof profiling plane: phase/device-time "
+                    "accounting, compile ledger, memory watermarks; with DIR "
+                    "also capture jax.profiler traces into it (YTK_PROF "
+                    "everywhere else; see docs/observability.md)")
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args(argv)
     _setup_logging(args.verbose)
     _setup_trace(args.trace_out)
+    _setup_profile(args.profile)
 
     from .config import knobs
 

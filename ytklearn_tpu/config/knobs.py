@@ -154,6 +154,23 @@ _knob("YTK_OBS_HISTORY_N", "int", 256,
 _knob("YTK_OBS_HISTORY_S", "float", 1.0,
       "metrics-history sampling interval in seconds (the obs heartbeat "
       "sampler thread snapshots every counter/gauge this often)")
+_knob("YTK_PROF", "str", None,
+      "profiling plane (ytkprof): `1` arms phase accounting, the compile "
+      "ledger, and the memory-watermark sampler; a *path* additionally "
+      "captures `jax.profiler.trace` output for capture-opted phases into "
+      "that directory (Perfetto-loadable); unset/`0` = off with zero new "
+      "per-call work on the span hot path — see "
+      "[observability.md](observability.md) \"Profiling plane\"")
+_knob("YTK_PROF_TOPK", "int", 10,
+      "rows kept in the ytkprof top-k kernel table (per parsed capture "
+      "and in the `ytkprof` report schema)")
+_knob("YTK_PROF_MEM_S", "float", 0.5,
+      "memory-watermark sampler interval in seconds (device bytes-in-use "
+      "+ host RSS into bounded rings, peaks attributed to the enclosing "
+      "profiler phase)")
+_knob("YTK_PROF_LEDGER_N", "int", 512,
+      "compile-ledger ring capacity: the newest N jit compiles kept with "
+      "program label, abstract arg signature, and compile ms")
 _knob("YTK_QUALITY_SAMPLE", "float", 0.05,
       "model-quality plane row-sample rate: the fraction of served rows "
       "whose feature values and scores feed the per-model drift sketches "

@@ -53,6 +53,7 @@ from ..obs import (
     gauge as obs_gauge,
     health,
     inc as obs_inc,
+    profiler,
     recorder,
     span as obs_span,
 )
@@ -947,6 +948,15 @@ class GBDTTrainer:
         # is an unexpected recompilation — a retrace storm shows up here
         # instead of as silently-tripled round times
         self._retrace = health.RetraceSentinel("gbdt.rounds")
+        # retrace culprit vocabulary: the sentinel arms/checks with the
+        # CURRENT round-call signature (late-binding closure over `carry`)
+        # so a fired health.retrace names the argument/dim that moved;
+        # computed only at sync cadence, and only with ytkprof on
+        self._retrace_sig = (
+            (lambda: profiler.abstract_signature(carry, data))
+            if profiler.enabled()
+            else None
+        )
         profile_dir = knobs.get_str("YTK_PROFILE_DIR")
         if profile_dir:
             jax.profiler.start_trace(profile_dir)
@@ -965,7 +975,10 @@ class GBDTTrainer:
                 )
             # enqueue-side span: the round program is async, so this
             # measures dispatch (device time shows up in the sync spans)
-            with obs_span("gbdt.round", round=rnd):
+            with obs_span("gbdt.round", round=rnd), profiler.LEDGER.program(
+                "gbdt.round",
+                sig_fn=lambda: profiler.abstract_signature(carry, data),
+            ):
                 carry = jit_round(
                     carry, jnp.asarray(rnd), jax.random.fold_in(root_key, rnd), data
                 )
@@ -1015,13 +1028,13 @@ class GBDTTrainer:
         recorder.set_config_fingerprint(p)
         health.install_trace_counters()
         if train is None:
-            with obs_span("gbdt.load"):
+            with profiler.phase("gbdt.load"):
                 train, test = GBDTIngest(p, self.fs).load()
         ts["load"] = time.time() - t0
         health.record_memory("gbdt.load")
         K = self.K
 
-        with obs_span("gbdt.preprocess", F=train.n_features):
+        with profiler.phase("gbdt.preprocess", F=train.n_features):
             dd = self._prep_device_inputs(train, test)
         health.record_memory("gbdt.preprocess")
         bins = dd.bins
@@ -1064,11 +1077,21 @@ class GBDTTrainer:
             )
 
         carry = (scores, scores_t, bufs, loss_buf, tloss_buf)
-        jit_round, spec = self._probe_compile(
-            jit_round, carry, data, dd, has_test, spec, start_round
-        )
+        # compile probe gets its own phase (it dominates short runs —
+        # without it the ytkprof wall-time decomposition can't hit its
+        # coverage bar) and a ledger label so every backend compile of
+        # the round program lands named, with its argument signature
+        with profiler.phase("gbdt.compile"), profiler.LEDGER.program(
+            "gbdt.round",
+            sig_fn=lambda: profiler.abstract_signature(carry, data),
+        ):
+            jit_round, spec = self._probe_compile(
+                jit_round, carry, data, dd, has_test, spec, start_round
+            )
         self.grow_spec = spec  # what actually ran (after any downgrade)
-        with obs_span("gbdt.train", rounds=p.round_num - start_round):
+        with profiler.phase(
+            "gbdt.train", capture=True, rounds=p.round_num - start_round
+        ):
             carry = self._run_rounds(
                 jit_round, carry, data, dd, model, train.feature_names,
                 start_round, has_test, t0, ts,
@@ -1078,7 +1101,7 @@ class GBDTTrainer:
         self.wave_log = np.asarray(jax.device_get(bufs["wlog"]))
         self._export_wave_stats(ts, dd, spec)
         t_fin = time.time()
-        with obs_span("gbdt.finalize"):
+        with profiler.phase("gbdt.finalize"):
             out = self._finalize_device(
                 model, bins, scores, y, weight, scores_t, y_t, w_t,
                 bufs, loss_buf, tloss_buf, start_round, train.feature_names, t0,
@@ -1110,10 +1133,12 @@ class GBDTTrainer:
         if not health.enabled():
             return
         health.check_loss("gbdt.sync", tl, round=rnd)
+        sig_fn = getattr(self, "_retrace_sig", None)
+        sig = sig_fn() if sig_fn is not None else None
         if self._retrace.baseline is None:
-            self._retrace.arm()
+            self._retrace.arm(sig=sig)
         else:
-            self._retrace.check(round=rnd)
+            self._retrace.check(sig=sig, round=rnd)
 
     def _preempt_checkpoint(self, model, bufs, bins, names, rnd: int) -> None:
         """Emergency checkpoint at round boundary `rnd`, then Preempted."""

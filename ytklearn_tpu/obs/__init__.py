@@ -47,6 +47,20 @@ Model-quality plane (obs/quality.py — docs/observability.md
                                      health.calibration sentinels),
                                      fleet merge of per-replica sketches;
                                      YTK_QUALITY_* / YTK_HEALTH_DRIFT_*
+
+Profiling plane (obs/profiler.py — docs/observability.md "Profiling
+plane"):
+
+  profiler                           ytkprof: phase accounting with
+                                     settled wall time + per-phase
+                                     jax.profiler captures (device-time
+                                     buckets per span, top-k kernel
+                                     table), compile ledger (program
+                                     label + abstract-signature diff →
+                                     named retrace culprits), background
+                                     memory-watermark sampler with
+                                     phase-attributed peaks;
+                                     YTK_PROF / YTK_PROF_* knobs
 """
 
 from .core import (  # noqa: F401
@@ -79,6 +93,6 @@ from .heartbeat import (  # noqa: F401
     start_history_sampler,
     stop_history_sampler,
 )
-from . import health, recorder, trace  # noqa: F401
+from . import health, profiler, recorder, trace  # noqa: F401
 from .health import HealthError, SLOBurnSentinel  # noqa: F401
 from .trace import TRACE_HEADER, configure_tracing  # noqa: F401

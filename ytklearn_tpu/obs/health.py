@@ -594,23 +594,45 @@ class RetraceSentinel:
     `compile.traces.backend_compile` counter above the armed baseline
     fires `health.retrace` + `compile.retraces.unexpected` and re-baselines.
     Needs install_trace_counters() + obs enabled (otherwise the counter
-    never moves and check() is a dict lookup)."""
+    never moves and check() is a dict lookup).
 
-    __slots__ = ("site", "baseline")
+    Culprit naming (r20): pass the call's abstract signature
+    (`profiler.abstract_signature(...)`) to arm()/check() and the fired
+    event carries `changed` — the argument/dim diff vs the armed entry.
+    When the ytkprof plane is on, the event additionally carries
+    `culprits`: the compile-ledger entries (program label + per-program
+    signature diff) that landed between arm and the tripping check, so
+    the postmortem names *which program* recompiled even when the loop's
+    own arguments never changed."""
+
+    __slots__ = ("site", "baseline", "sig", "_ledger_seq")
 
     def __init__(self, site: str):
         self.site = site
         self.baseline: Optional[float] = None
+        self.sig = None
+        self._ledger_seq = 0
 
     @staticmethod
     def _compiles() -> float:
         return core.REGISTRY.counters.get("compile.traces.backend_compile", 0.0)
 
-    def arm(self) -> None:
+    @staticmethod
+    def _ledger():
+        from . import profiler
+
+        return profiler.LEDGER if profiler.enabled() else None
+
+    def arm(self, sig=None) -> None:
         if _state.on:
             self.baseline = self._compiles()
+            if sig is not None:
+                self.sig = sig
+            led = self._ledger()
+            if led is not None:
+                self._ledger_seq = led.mark()
 
-    def check(self, **args) -> bool:
+    def check(self, sig=None, **args) -> bool:
         if not _state.on or self.baseline is None:
             return True
         cur = self._compiles()
@@ -619,6 +641,22 @@ class RetraceSentinel:
         n = cur - self.baseline
         self.baseline = cur
         core.inc("compile.retraces.unexpected", n)
+        from . import profiler
+
+        if sig is not None:
+            changed = profiler.signature_diff(self.sig, sig)
+            if changed:
+                args["changed"] = changed
+            self.sig = sig
+        led = self._ledger()
+        if led is not None:
+            culprits = [
+                {k: e[k] for k in ("program", "ms", "changed") if k in e}
+                for e in led.entries_since(self._ledger_seq)
+            ]
+            if culprits:
+                args["culprits"] = culprits
+            self._ledger_seq = led.mark()
         _fire(
             "retrace",
             self.site,

@@ -207,6 +207,18 @@ def _dump(reason: str, exc: Optional[BaseException]) -> str:
     # ytklint: allow(broad-except) reason=the flight dump must land even when the trace plane is the broken part
     except Exception:
         pass
+    try:
+        from . import profiler as _profiler
+
+        prof = _profiler.flight_block()
+        if prof is not None:
+            # an OOM/crash postmortem names the allocating phase: phase
+            # wall table, compile-ledger tail, phase-attributed memory
+            # peak watermarks (None — and absent — when ytkprof is off)
+            flight["prof"] = prof
+    # ytklint: allow(broad-except) reason=the flight dump must land even when the profiling plane is the broken part
+    except Exception:
+        pass
 
     _state.dump_seq += 1
     ts = time.strftime("%Y%m%d-%H%M%S")

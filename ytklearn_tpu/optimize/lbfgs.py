@@ -38,7 +38,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..obs import health, inc as obs_inc, span as obs_span
+from ..obs import health, inc as obs_inc, profiler, span as obs_span
 
 _MODES = {"sufficient_decrease": 0, "wolfe": 1, "strong_wolfe": 2}
 
@@ -419,7 +419,13 @@ def minimize_lbfgs(
     from ..obs import recorder
 
     recorder.auto_install()  # flight ring for postmortems (no-op when obs off)
-    with obs_span("lbfgs.first_eval", dim=dim):
+    # phase + ledger label: first_eval absorbs the program compiles, so
+    # the ytkprof compile ledger names them (and the wall decomposition
+    # separates compile-dominated warmup from steady iterations)
+    with profiler.phase("lbfgs.first_eval", dim=dim), profiler.LEDGER.program(
+        "lbfgs.first_eval",
+        sig_fn=lambda: profiler.abstract_signature(w0, reg, batch),
+    ):
         pure, loss, g, wnorm, gnorm = first_eval(jnp.asarray(w0, dtype), reg, batch)
     wnorm = max(float(wnorm), 1.0)
     state = LBFGSState(
@@ -448,35 +454,43 @@ def minimize_lbfgs(
     # YTK_HEALTH=0 drops both the checks and the fetch (one attribute load).
     health_on = health.enabled()
     guard = health.ProgressGuard("lbfgs", window=10) if health_on else None
-    for it in range(1, config.max_iter + 1):
-        # the span's ls_status fetch doubles as the device sync the loop
-        # needs anyway — the duration is device-settled for free
-        with obs_span("lbfgs.iteration", it=it):
-            state, wnorm, gnorm = iteration(state, reg, batch)
-            ls = int(state.ls_status)
-        obs_inc("lbfgs.iterations")
-        if health_on:
-            # outside the span so a strict escalation's flight dump carries
-            # the failing iteration's completed span in its ring
-            loss_val = float(state.loss)
-            if not health.check_loss("lbfgs.loss", loss_val, it=it):
-                status = "nan_loss"
+    # iterations run inside one ytkprof phase (opt-in capture: the kernel
+    # table for the solve comes from here); state/reg/batch shapes are
+    # static after warmup, so any ledger entry the loop produces IS an
+    # unexpected retrace with its signature attached
+    with profiler.phase("lbfgs.iterations", capture=True):
+        for it in range(1, config.max_iter + 1):
+            # the span's ls_status fetch doubles as the device sync the loop
+            # needs anyway — the duration is device-settled for free
+            with obs_span("lbfgs.iteration", it=it), profiler.LEDGER.program(
+                "lbfgs.iteration",
+                sig_fn=lambda: profiler.abstract_signature(state, reg, batch),
+            ):
+                state, wnorm, gnorm = iteration(state, reg, batch)
+                ls = int(state.ls_status)
+            obs_inc("lbfgs.iterations")
+            if health_on:
+                # outside the span so a strict escalation's flight dump
+                # carries the failing iteration's completed span in its ring
+                loss_val = float(state.loss)
+                if not health.check_loss("lbfgs.loss", loss_val, it=it):
+                    status = "nan_loss"
+                    break
+                guard.update(loss_val, it=it)
+            if ls > 1:
+                # trials beyond the first = line-search retries (step rescales)
+                obs_inc("lbfgs.ls_retries", ls - 1)
+            if ls < 0:
+                obs_inc("lbfgs.ls_failures")
+                status = f"line_search_failed({ls})"
                 break
-            guard.update(loss_val, it=it)
-        if ls > 1:
-            # trials beyond the first = line-search retries (step rescales)
-            obs_inc("lbfgs.ls_retries", ls - 1)
-        if ls < 0:
-            obs_inc("lbfgs.ls_failures")
-            status = f"line_search_failed({ls})"
-            break
-        if callback is not None and callback(it, state):
-            status = "callback_stop"
-            break
-        if float(gnorm) / max(float(wnorm), 1.0) <= config.eps:
-            status = "converged"
-            converged = True
-            break
+            if callback is not None and callback(it, state):
+                status = "callback_stop"
+                break
+            if float(gnorm) / max(float(wnorm), 1.0) <= config.eps:
+                status = "converged"
+                converged = True
+                break
     return _result(state, it, status, converged)
 
 

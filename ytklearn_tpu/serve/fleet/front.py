@@ -1015,7 +1015,7 @@ class FleetFront:
         }
 
     def _scrape_replica(self, rid: int, h: ReplicaHandle,
-                        quality: bool = False) -> dict:
+                        quality: bool = False, prof: bool = False) -> dict:
         info = {
             "replica_id": rid,
             "pid": h.pid,
@@ -1026,7 +1026,8 @@ class FleetFront:
         }
         if h.state != "ready":
             return info
-        path = "/metrics?raw=1" + ("&quality=1" if quality else "")
+        path = ("/metrics?raw=1" + ("&quality=1" if quality else "")
+                + ("&prof=1" if prof else ""))
         try:
             # quality scrapes carry serialized sketches + run an eval on
             # the replica — give them more room than the 2s liveness poll
@@ -1045,6 +1046,11 @@ class FleetFront:
                 info["cache"] = m["cache"]
             if quality and "quality" in m:
                 info["quality"] = m["quality"]
+            if prof and "prof" in m:
+                # per-replica per-rung kernel-time attribution (ytkprof;
+                # the replica answers even with the plane off — then the
+                # block says enabled:false with empty rung tables)
+                info["prof"] = m["prof"]
             counters = m.get("counters") or {}
             info["counters"] = {
                 k: v for k, v in counters.items()
@@ -1054,7 +1060,7 @@ class FleetFront:
         return info
 
     def metrics_payload(self, history: bool = False,
-                        quality: bool = False) -> dict:
+                        quality: bool = False, prof: bool = False) -> dict:
         per: Dict[str, dict] = {}
         ring_union: List[float] = []
         now = time.time()
@@ -1066,7 +1072,9 @@ class FleetFront:
         results: Dict[int, dict] = {}
 
         def _scrape(rid, h):
-            results[rid] = self._scrape_replica(rid, h, quality=quality)
+            results[rid] = self._scrape_replica(
+                rid, h, quality=quality, prof=prof
+            )
 
         scrapers = [
             threading.Thread(target=_scrape, args=(rid, h), daemon=True)
@@ -1221,8 +1229,9 @@ class FleetFront:
                 elif path == "/metrics":
                     hist = query.get("history", ["0"])[0] not in ("0", "")
                     qual = query.get("quality", ["0"])[0] not in ("0", "")
+                    prof = query.get("prof", ["0"])[0] not in ("0", "")
                     self._json(200, front.metrics_payload(
-                        history=hist, quality=qual))
+                        history=hist, quality=qual, prof=prof))
                 elif path == "/admin/traces":
                     self._json(200, front.traces_payload())
                 else:

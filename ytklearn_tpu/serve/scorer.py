@@ -35,6 +35,7 @@ import contextlib
 import logging
 import math
 import threading
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -42,6 +43,7 @@ import numpy as np
 from ..config import knobs
 from ..obs import health as obs_health
 from ..obs import event as obs_event, inc as obs_inc, span as obs_span
+from ..obs import profiler
 from ..obs import trace as obs_trace
 from ..predict.base import OnlinePredictor, numpy_activation
 from ..predict.continuous import (
@@ -179,6 +181,11 @@ class CompiledScorer:
         self._sentinel = _LadderRetraceSentinel("serve.scorer")
         self._warm = False
         self._rearm_pending = False
+        # ytkprof per-rung attribution: settled execute seconds + row
+        # counts per ladder rung (written only when the plane is on; read
+        # by /metrics?prof=1 via prof_snapshot)
+        self._prof_lock = threading.Lock()
+        self._rung_stats: Dict[int, dict] = {}
         if warmup:
             self.warmup()
 
@@ -194,7 +201,16 @@ class CompiledScorer:
             with obs_span("serve.warmup", rungs=len(self.ladder)):
                 for rung in self.ladder:
                     X = np.full((rung, self.dim), self._fill, np.float64)
-                    self._exec(X)  # blocks: compile+execute now
+                    # ledger label (no-op unless ytkprof is on): the rung
+                    # compiles land named with their batch signature, so
+                    # a later steady-state retrace's culprit diff reads
+                    # "serve.rung.64: float64[64,D] -> ..." instead of
+                    # "<unlabeled>"
+                    with profiler.LEDGER.program(
+                        "serve.rung.%d" % rung,
+                        sig_fn=lambda x=X: profiler.abstract_signature(x),
+                    ):
+                        self._exec(X)  # blocks: compile+execute now
                     obs_inc("serve.scorer.warmup_rungs")
         self._sentinel.arm()
         self._warm = True
@@ -306,6 +322,7 @@ class CompiledScorer:
         with obs_trace.batch_hop("serve.assemble", rows=len(rows)):
             X = self.featurize(rows)
         B = X.shape[0]
+        prof_on = profiler.enabled()  # one check per batch, not per chunk
         max_rung = self.ladder[-1]
         out_s: List[np.ndarray] = []
         out_p: List[np.ndarray] = []
@@ -327,7 +344,24 @@ class CompiledScorer:
                     "serve.execute", rung=rung, mode=self.mode,
                     backend=self.backend,
                 ):
-                    s, p = self._exec(chunk)
+                    if prof_on:
+                        # settled per-rung attribution: _exec device_gets,
+                        # so this wall interval IS the rung's kernel+copy
+                        # time; any compile inside lands named in the
+                        # ledger with the chunk signature
+                        t_exec = time.perf_counter()
+                        with profiler.LEDGER.program(
+                            "serve.rung.%d" % rung,
+                            sig_fn=lambda c=chunk: (
+                                profiler.abstract_signature(c)
+                            ),
+                        ):
+                            s, p = self._exec(chunk)
+                        self._note_rung(
+                            rung, rung - pad, time.perf_counter() - t_exec
+                        )
+                    else:
+                        s, p = self._exec(chunk)
             obs_inc("serve.scorer.batches")
             obs_inc("serve.scorer.rows", rung - pad)
             obs_inc("serve.scorer.pad_rows", pad)
@@ -348,6 +382,43 @@ class CompiledScorer:
             shape = (0,) if self.n_outputs == 1 else (0, self.n_outputs)
             return np.empty(shape, np.float64), np.empty(shape, np.float64)
         return np.concatenate(out_s), np.concatenate(out_p)
+
+    def _note_rung(self, rung: int, rows: int, exec_s: float) -> None:
+        with self._prof_lock:
+            st = self._rung_stats.get(rung)
+            if st is None:
+                st = self._rung_stats[rung] = {
+                    "calls": 0, "rows": 0, "exec_s": 0.0,
+                }
+            st["calls"] += 1
+            st["rows"] += rows
+            st["exec_s"] += exec_s
+
+    def prof_snapshot(self) -> dict:
+        """Per-rung settled execute-time attribution (ytkprof; the
+        `/metrics?prof=1` export). Empty rungs dict when the plane was
+        never on — the closing of the r16 "tuned blind" gap: each ladder
+        rung reports its device-settled seconds, calls, real rows, and
+        derived per-row cost so a mis-tuned ladder is visible in numbers."""
+        with self._prof_lock:
+            rungs = {
+                str(r): {
+                    "calls": v["calls"],
+                    "rows": v["rows"],
+                    "exec_s": round(v["exec_s"], 6),
+                    "ms_per_row": (
+                        round(1000.0 * v["exec_s"] / v["rows"], 6)
+                        if v["rows"] else None
+                    ),
+                }
+                for r, v in sorted(self._rung_stats.items())
+            }
+        return {
+            "mode": self.mode,
+            "backend": self.backend,
+            "ladder": list(self.ladder),
+            "rungs": rungs,
+        }
 
     # -- lowering ---------------------------------------------------------
 

@@ -351,7 +351,7 @@ class ServeApp:
         }
 
     def metrics_payload(self, raw: bool = False, history: bool = False,
-                        quality: bool = False) -> dict:
+                        quality: bool = False, prof: bool = False) -> dict:
         snap = obs_snapshot()
         with self._batchers_lock:  # batcher_for inserts concurrently
             batchers = dict(self._batchers)
@@ -406,6 +406,21 @@ class ServeApp:
                 self.quality.snapshot(include_sketches=True)
                 if self.quality.enabled() else {}
             )
+        if prof:
+            # ytkprof plane (obs/profiler.py): per-model per-rung settled
+            # execute-time attribution + the process compile ledger —
+            # enabled:false with empty blocks when YTK_PROF is off
+            from ..obs import profiler as obs_profiler
+
+            out["prof"] = {
+                "enabled": obs_profiler.enabled(),
+                "models": {
+                    n: self.registry.get(n).scorer.prof_snapshot()
+                    for n in self.registry.names()
+                },
+                "compile": obs_profiler.LEDGER.snapshot(limit=16),
+                "phases": obs_profiler.phases_snapshot(),
+            }
         return out
 
     # -- lifecycle --------------------------------------------------------
@@ -480,8 +495,9 @@ class ServeApp:
                     raw = query.get("raw", ["0"])[0] not in ("0", "")
                     hist = query.get("history", ["0"])[0] not in ("0", "")
                     qual = query.get("quality", ["0"])[0] not in ("0", "")
+                    prof = query.get("prof", ["0"])[0] not in ("0", "")
                     self._json(200, app.metrics_payload(
-                        raw=raw, history=hist, quality=qual))
+                        raw=raw, history=hist, quality=qual, prof=prof))
                 elif path == "/admin/traces":
                     # the per-process exemplar ring: head-sampled + tail-
                     # retained request traces (obs/trace.py); obs_report
