@@ -815,6 +815,127 @@ def check_prof(old, new, tol: float) -> List[str]:
     return fails
 
 
+# ---------------------------------------------------------------------------
+# MESH (mesh-obs drill) artifacts — per-model isolation gate
+# ---------------------------------------------------------------------------
+
+
+def find_mesh_artifacts(repo: str) -> List[Tuple[int, str]]:
+    """[(round, path)] sorted (MESH_r<NN>.json — scripts/mesh_drill.py)."""
+    out = []
+    for path in glob.glob(os.path.join(repo, "MESH_*.json")):
+        m = re.search(r"MESH_r?(\d+)\.json$", os.path.basename(path))
+        if m:
+            out.append((int(m.group(1)), path))
+    return sorted(out)
+
+
+def _mesh_identity(rec: dict) -> tuple:
+    """Comparable = same drill metric, fleet size, and model cast — a
+    3-model 2-replica drill must not gate against a different shape."""
+    return (
+        rec.get("metric"),
+        rec.get("replicas"),
+        tuple(sorted((rec.get("models") or {}).keys())),
+    )
+
+
+def mesh_comparable_pair(artifacts: List[Tuple[int, str]]):
+    """(older, newest) ytkmesh_drill records with matching identity, or
+    None. Unreadable / wrong-schema artifacts are skipped, not fatal."""
+    usable = []
+    for rnd, path in artifacts:
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except Exception as e:  # noqa: BLE001 — a rotten artifact is a skip
+            print(f"  [skip] {os.path.basename(path)}: unreadable ({e})")
+            continue
+        if rec.get("schema") != "ytkmesh_drill":
+            print(f"  [skip] {os.path.basename(path)}: schema "
+                  f"{rec.get('schema')!r} is not ytkmesh_drill")
+            continue
+        usable.append((rnd, path, rec))
+    if not usable:
+        return None, None
+    newest = usable[-1]
+    for older in reversed(usable[:-1]):
+        if _mesh_identity(older[2]) == _mesh_identity(newest[2]):
+            return older, newest
+    return None, newest
+
+
+def check_mesh_absolute(newest) -> List[str]:
+    """Newest drill alone: the tenant-isolation invariants are absolute,
+    not relative — the abusive model's burn sentinel fired BY NAME, the
+    quiet models' sentinels stayed silent, and per-model counters summed
+    exactly to their global twins on every replica (conservation)."""
+    rnd, path, rec = newest
+    base = os.path.basename(path)
+    fails = []
+    iso = rec.get("burn_isolation") or {}
+    print(
+        f"  mesh burn isolation (r{rnd}): abusive {iso.get('abusive')!r} "
+        f"fired {iso.get('abusive_fired')}, quiet fired "
+        f"{iso.get('quiet_fired')}"
+    )
+    if not iso.get("ok"):
+        fails.append(
+            f"burn isolation broke in {base}: abusive model "
+            f"{iso.get('abusive')!r} fired {iso.get('abusive_fired')} "
+            f"window(s), quiet models fired {iso.get('quiet_fired')} "
+            "(want >=1 and ==0)"
+        )
+    cons = rec.get("conservation") or {}
+    print(f"  mesh conservation (r{rnd}): ok={cons.get('ok')}")
+    if not cons.get("ok"):
+        fails.append(
+            f"per-model counter conservation broke in {base}: "
+            "sum(serve.model.*.<c>) != serve.<c> on some replica "
+            "(see conservation.per_replica)"
+        )
+    if not rec.get("ok"):
+        fails.append(
+            f"mesh drill recorded failures in {base}: "
+            f"{rec.get('failures')}"
+        )
+    return fails
+
+
+def check_mesh(old, new, tol: float) -> List[str]:
+    """Pair gate: the QUIET models' fleet p99 within band of the
+    predecessor — the accounting plane must not tax the tenants it
+    protects. The abusive model's latency is the drill's subject
+    (saturated by design), so it is exempt. Band is wide by default
+    (MESH_P99_TOL): micro-fleet latency on a shared box is noisy."""
+    (o_rnd, o_path, o), (n_rnd, n_path, n) = old, new
+    fails = []
+    abusive = (n.get("burn_isolation") or {}).get("abusive")
+    for name in sorted((n.get("models") or {})):
+        if name == abusive:
+            continue
+        o_p99 = ((o.get("models") or {}).get(name) or {}).get(
+            "latency", {}).get("p99_ms")
+        n_p99 = ((n.get("models") or {}).get(name) or {}).get(
+            "latency", {}).get("p99_ms")
+        if o_p99 is None or n_p99 is None or o_p99 <= 0:
+            print(f"  [skip] mesh pair {name!r}: missing fleet p99")
+            continue
+        ceil = o_p99 * (1.0 + tol)
+        print(
+            f"  mesh quiet p99 {name!r}: r{n_rnd} {n_p99:.2f} ms vs "
+            f"r{o_rnd} {o_p99:.2f} ms (ceiling {ceil:.2f} ms, "
+            f"tol {tol:.0%})"
+        )
+        if n_p99 > ceil:
+            fails.append(
+                f"quiet model {name!r} fleet p99 regressed: "
+                f"{n_p99:.2f} ms > {o_p99:.2f} ms * (1 + {tol}) in "
+                f"{os.path.basename(n_path)} (env MESH_P99_TOL)"
+            )
+    return fails
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument(
@@ -908,6 +1029,23 @@ def main(argv=None) -> int:
         fails += check_prof(
             prof_older, prof_newest,
             tol=float(os.environ.get("PROF_COMPILE_TOL", "0.75")),
+        )
+
+    # mesh-obs gate: newest per-model isolation drill re-gated absolutely
+    # (burn named the tenant, conservation exact), plus a quiet-model p99
+    # band vs a comparable predecessor
+    mesh_artifacts = find_mesh_artifacts(args.dir)
+    print(f"check_bench_regress: {len(mesh_artifacts)} MESH artifact(s)")
+    mesh_older, mesh_newest = mesh_comparable_pair(mesh_artifacts)
+    if mesh_newest is not None:
+        fails += check_mesh_absolute(mesh_newest)
+    if mesh_older is None:
+        print("check_bench_regress: SKIP mesh pair gate (fewer than two "
+              "comparable MESH artifacts)")
+    else:
+        fails += check_mesh(
+            mesh_older, mesh_newest,
+            tol=float(os.environ.get("MESH_P99_TOL", "0.75")),
         )
 
     if fails:

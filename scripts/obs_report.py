@@ -31,6 +31,11 @@ Input kind is sniffed, not flagged:
                 additionally writes every ring merged into one
                 clock-aligned Chrome trace (each process's wall_t0
                 anchors its hop offsets — the spawn-banner handshake)
+  mesh drill    a scripts/mesh_drill.py artifact (schema "ytkmesh_drill")
+                — the per-model fleet table, top talkers, and the
+                burn-isolation + conservation verdicts; any /metrics
+                snapshot saved with ?models=1 (and flight dumps from
+                serving processes) gets the same per-model section
   lint report   `ytklint --format json` / `check_lint.sh --json` output
                 (schema "ytklint") — findings per rule plus the live
                 reasoned-suppression inventory, so CI annotations and
@@ -124,6 +129,15 @@ def _load(path: str) -> Tuple[str, dict]:
             "bench": None,
             "prof_drill": doc,
         }
+    if doc.get("schema") == "ytkmesh_drill":
+        return "mesh-drill", {
+            "events": [],
+            "counters": {},
+            "gauges": {},
+            "flight": None,
+            "bench": None,
+            "mesh_drill": doc,
+        }
     if "flight" in doc:
         fl = doc["flight"]
         snap = fl.get("snapshot") or {}
@@ -133,6 +147,7 @@ def _load(path: str) -> Tuple[str, dict]:
             "gauges": snap.get("gauges") or {},
             "flight": fl,
             "bench": None,
+            "model_metrics": fl.get("model_metrics"),
         }
     if "traceEvents" in doc:
         events, counters = [], {}
@@ -179,6 +194,7 @@ def _load(path: str) -> Tuple[str, dict]:
             "history": doc.get("history"),
             "quality": doc.get("quality"),
             "prof": doc.get("prof"),
+            "model_metrics": doc.get("model_metrics"),
         }
     if "latency" in doc and "counters" in doc and "metric" not in doc:
         # a replica/solo ServeApp /metrics snapshot (?history=1 carries
@@ -192,6 +208,7 @@ def _load(path: str) -> Tuple[str, dict]:
             "history": doc.get("history"),
             "quality": doc.get("quality"),
             "prof": doc.get("prof"),
+            "model_metrics": doc.get("model_metrics"),
         }
     rec = doc.get("parsed") if ("parsed" in doc and "cmd" in doc) else doc
     rec = rec or {}
@@ -562,6 +579,53 @@ def render_serve_prof(prof: dict) -> None:
                   f"{v.get('ms', 0):>9.1f} ms")
 
 
+def render_model_metrics(block: Optional[dict]) -> None:
+    """Render a mesh-obs per-model block — either a replica/solo
+    `model_metrics` snapshot (`/metrics?models=1`, flight dumps) or the
+    fleet front's merged table (same key, with `replicas` sub-blocks and
+    a `top_talkers` ranking)."""
+    if not block or not block.get("models"):
+        return
+    _section("per-model accounting (mesh-obs)")
+    if block.get("max_models") is not None:
+        print(f"  family budget: {block['max_models']} "
+              "(excess collapses into __overflow__)")
+    hdr = (f"  {'model':<16s} {'reqs':>8s} {'rows':>9s} {'shed':>6s} "
+           f"{'504':>5s} {'hit%':>6s} {'p50 ms':>8s} {'p99 ms':>8s} "
+           f"{'fired':>6s}")
+    print(hdr)
+    for name, mb in sorted(block["models"].items()):
+        c = mb.get("counters") or {}
+        lat = mb.get("latency") or {}
+        hit, miss = c.get("cache.hit", 0.0), c.get("cache.miss", 0.0)
+        hit_pct = f"{100.0 * hit / (hit + miss):.1f}" if hit + miss else "-"
+        slo = mb.get("slo") or {}
+        print(
+            f"  {name[:16]:<16s} {c.get('requests', 0):>8.0f} "
+            f"{c.get('request_rows', 0):>9.0f} {c.get('shed', 0):>6.0f} "
+            f"{c.get('deadline_expired', 0):>5.0f} {hit_pct:>6s} "
+            f"{str(lat.get('p50_ms', '-')):>8s} "
+            f"{str(lat.get('p99_ms', '-')):>8s} "
+            f"{str(slo.get('windows_fired', '-')):>6s}"
+        )
+        for rid, rep in sorted((mb.get("replicas") or {}).items()):
+            rl = rep.get("latency") or {}
+            rs = rep.get("slo") or {}
+            print(f"    replica {rid}: p50={rl.get('p50_ms')} "
+                  f"p99={rl.get('p99_ms')} ms (n={rl.get('count')}) "
+                  f"fired={rs.get('windows_fired', '-')}")
+        nf = c.get("not_found")
+        if nf:
+            print(f"    not_found: {nf:g} (unknown-name requests)")
+    talkers = block.get("top_talkers") or []
+    if talkers:
+        print("  top talkers (by served rows):")
+        for t in talkers[:8]:
+            print(f"    {t.get('model', '?')[:24]:<24s} "
+                  f"{t.get('request_rows', 0):>9.0f} rows  "
+                  f"{100.0 * (t.get('share') or 0):>5.1f}%")
+
+
 def report(path: str, perfetto: Optional[str] = None) -> None:
     kind, data = _load(path)
     counters, gauges, events = data["counters"], data["gauges"], data["events"]
@@ -661,6 +725,30 @@ def report(path: str, perfetto: Optional[str] = None) -> None:
             print(f"  FAIL: {msg}")
         if pd.get("prof"):
             render_prof(pd["prof"])
+        return
+
+    md = data.get("mesh_drill")
+    if md:
+        _section("mesh drill (scripts/mesh_drill.py)")
+        print(f"  ok: {md.get('ok')}  {md.get('replicas')} replicas, "
+              f"{len(md.get('models') or {})} models, "
+              f"{md.get('requests')} requests")
+        iso = md.get("burn_isolation") or {}
+        print(f"  burn isolation: abusive {iso.get('abusive')!r} fired "
+              f"{iso.get('abusive_fired')} window(s), quiet fired "
+              f"{iso.get('quiet_fired')} (ok={iso.get('ok')})")
+        cons = md.get("conservation") or {}
+        print(f"  conservation: ok={cons.get('ok')} "
+              f"(per-model sums == global twins on every replica)")
+        ov = md.get("overhead") or {}
+        if ov:
+            print(f"  ?models=1 payload cost: {ov.get('models_ms')} ms vs "
+                  f"{ov.get('plain_ms')} ms plain "
+                  f"(x{ov.get('ratio')}, band x{ov.get('band')})")
+        render_model_metrics({"models": md.get("models") or {},
+                              "top_talkers": md.get("top_talkers")})
+        for msg in md.get("failures") or []:
+            print(f"  FAIL: {msg}")
         return
 
     prof_rep = data.get("prof")
@@ -951,6 +1039,7 @@ def report(path: str, perfetto: Optional[str] = None) -> None:
     if prof_rep and kind in ("serve-metrics", "fleet-metrics"):
         render_serve_prof(prof_rep)
 
+    render_model_metrics(data.get("model_metrics"))
     render_quality(data.get("quality"))
     render_history(data.get("history"))
 

@@ -1,0 +1,536 @@
+"""Per-model SLO, accounting & tenant-isolation plane (mesh-obs, ISSUE 18).
+
+Unit coverage for obs/model_metrics.py (bounded scoped families, the
+404-name-flood cardinality bound, per-model burn sentinels that fire by
+name), the conservation identity through ServeApp (per-model counter
+sums == global twins, exactly), the model-aware 429 Retry-After hint,
+the one-entry-per-payload scrape fix, per-scope cache occupancy, the
+fleet front's per-model ring union, and the YTK_OBS=0 no-op contract.
+"""
+
+import threading
+import time
+
+import pytest
+
+from serve_models import build_gbdt, build_linear
+from test_serve import _http, _load_prebuilt
+from ytklearn_tpu import obs
+from ytklearn_tpu.obs import health as obs_health
+from ytklearn_tpu.obs import model_metrics as mm
+from ytklearn_tpu.serve import BatchPolicy, MicroBatcher, ModelRegistry, ServeApp
+from ytklearn_tpu.serve.batcher import (
+    RETRY_AFTER_MAX_S,
+    DeadlineExceeded,
+    OverloadError,
+)
+from ytklearn_tpu.serve.fleet.cache import PredictionCache
+from ytklearn_tpu.serve.fleet.front import merge_model_metrics
+
+LADDER = (1, 4, 16)
+
+
+@pytest.fixture()
+def obs_on():
+    obs.configure(enabled=True)
+    obs.reset()
+    yield
+    obs.configure(enabled=False)
+    obs.reset()
+
+
+@pytest.fixture()
+def health_on():
+    obs_health.configure_health(on=True, strict=False)
+    yield
+    obs_health.configure_health(on=True, strict=None)
+
+
+def _two_model_app(tmp_path, **kw):
+    """ServeApp with two loaded models ("alpha" gbdt, "beta" linear)."""
+    gb, _ = build_gbdt(tmp_path)
+    lin, _ = build_linear(tmp_path)
+    reg = ModelRegistry(ladder=LADDER, watch_interval_s=0)
+    _load_prebuilt(reg, "alpha", gb)
+    _load_prebuilt(reg, "beta", lin)
+    app = ServeApp(reg, kw.pop("policy", BatchPolicy(max_wait_ms=0.5)), **kw)
+    return app, reg
+
+
+def _close(app, reg):
+    for b in app._batchers.values():
+        b.close(drain=True)
+    reg.close()
+
+
+# ---------------------------------------------------------------------------
+# parse_slo_models
+# ---------------------------------------------------------------------------
+
+
+def test_parse_slo_models():
+    assert mm.parse_slo_models(None) == {}
+    assert mm.parse_slo_models("") == {}
+    assert mm.parse_slo_models("hog:5") == {"hog": 5.0}
+    assert mm.parse_slo_models(" a:1.5 , b:20 ,") == {"a": 1.5, "b": 20.0}
+    # rpartition: the LAST colon splits, so names may carry colons
+    assert mm.parse_slo_models("ns:model:9") == {"ns:model": 9.0}
+    for bad in ("hog", ":5", "hog:abc", "hog:0", "hog:-1"):
+        with pytest.raises(ValueError):
+            mm.parse_slo_models(bad)
+
+
+# ---------------------------------------------------------------------------
+# bounded cardinality: register cap + 404 flood
+# ---------------------------------------------------------------------------
+
+
+def test_register_cap_lands_excess_in_overflow(obs_on):
+    m = mm.ModelMetrics(slo_ms=0.0, max_models=3)
+    assert m.register("a") == "a"
+    assert m.register("b") == "b"
+    assert m.register("c") == "c"
+    assert m.register("a") == "a"  # idempotent, not double-counted
+    assert m.register("d") == mm.OVERFLOW
+    assert m.register("e") == mm.OVERFLOW
+    assert m.register("d") == mm.OVERFLOW
+    # family map: exactly max_models named + the overflow bucket
+    assert m.names() == [mm.OVERFLOW, "a", "b", "c"]
+    # one names_collapsed tick per distinct collapsed name
+    c = obs.snapshot()["counters"]
+    assert c.get("serve.model.__overflow__.names_collapsed") == 2
+    # recording against a collapsed name lands on the overflow family
+    m.record_request("d", 4, 1.0)
+    c = obs.snapshot()["counters"]
+    assert c.get("serve.model.__overflow__.requests") == 1
+    assert c.get("serve.model.__overflow__.request_rows") == 4
+
+
+def test_404_name_flood_cannot_grow_the_family_map(obs_on):
+    m = mm.ModelMetrics(slo_ms=0.0, max_models=8)
+    for i in range(500):
+        m.record_not_found(f"nope-{i}")  # a flood of distinct bad names
+    assert m.names() == [mm.OVERFLOW]  # zero new families
+    c = obs.snapshot()["counters"]
+    assert c.get("serve.model.__overflow__.not_found") == 500
+    # and the obs registry itself gained ONE counter, not 500
+    flood = [k for k in c if k.startswith("serve.model.")]
+    assert flood == ["serve.model.__overflow__.not_found"]
+
+
+def test_family_lookup_never_creates(obs_on):
+    m = mm.ModelMetrics(slo_ms=0.0, max_models=4)
+    fam = m.family("ghost")
+    assert fam.scope == mm.OVERFLOW
+    assert m.scope_name("ghost") == mm.OVERFLOW
+    assert m.names() == [mm.OVERFLOW]
+
+
+# ---------------------------------------------------------------------------
+# snapshot shape
+# ---------------------------------------------------------------------------
+
+
+def test_snapshot_shape_counters_latency_slo(obs_on):
+    m = mm.ModelMetrics(slo_ms=50.0, max_models=4,
+                        slo_models={"hog": 5.0})
+    m.register("hog")
+    m.register("calm")
+    for _ in range(3):
+        m.record_request("hog", 2, 1.0)
+    m.record_request("calm", 1, 2.0)
+    snap = m.snapshot(raw=True)
+    assert snap["max_models"] == 4
+    models = snap["models"]
+    assert set(models) == {mm.OVERFLOW, "hog", "calm"}
+    hog = models["hog"]
+    # counters are prefix-stripped per family
+    assert hog["counters"]["requests"] == 3
+    assert hog["counters"]["request_rows"] == 6
+    assert hog["latency"]["count"] == 3
+    assert hog["latency"]["p99_ms"] >= hog["latency"]["p50_ms"]
+    # raw rings are (wall_ts, ms) pairs — the fleet union input
+    ts, ms = hog["latency"]["raw_ms"][0]
+    assert abs(time.time() - ts) < 60.0 and ms == 1.0
+    # per-model SLO override vs the app-wide default
+    assert hog["slo"]["slo_ms"] == 5.0
+    assert models["calm"]["slo"]["slo_ms"] == 50.0
+    assert models[mm.OVERFLOW]["slo"]["slo_ms"] == 50.0
+    assert hog["slo"]["windows_fired"] == 0
+    # without raw the ring stays out of the payload
+    assert "raw_ms" not in m.snapshot()["models"]["hog"]["latency"]
+
+
+# ---------------------------------------------------------------------------
+# per-model burn sentinel fires BY NAME
+# ---------------------------------------------------------------------------
+
+
+def test_per_model_sentinel_fires_by_name(obs_on, health_on):
+    m = mm.ModelMetrics(slo_ms=50.0, max_models=4,
+                        slo_models={"hog": 1.0},
+                        burn_window=8, burn_budget=0.5)
+    m.register("hog")
+    m.register("calm")
+    for i in range(8):
+        m.record_request("hog", 1, 30.0)   # 30ms > hog's 1ms SLO
+        m.record_request("calm", 1, 0.1)   # well under calm's 50ms
+    c = obs.snapshot()["counters"]
+    assert c.get("health.slo_burn.serve.model.hog") == 1
+    assert "health.slo_burn.serve.model.calm" not in c
+    ev = [e for e in obs.REGISTRY.events if e.get("name") == "health.slo_burn"]
+    assert ev and ev[-1]["args"]["site"] == "serve.model.hog"
+    assert ev[-1]["args"]["model"] == "hog"
+    assert m.snapshot()["models"]["hog"]["slo"]["windows_fired"] == 1
+    assert m.snapshot()["models"]["calm"]["slo"]["windows_fired"] == 0
+
+
+def test_violations_burn_budget_without_latency(obs_on, health_on):
+    """Shed 429s / expired 504s never produced a latency sample, but
+    they burn the named model's SLO budget all the same."""
+    m = mm.ModelMetrics(slo_ms=10.0, max_models=4,
+                        burn_window=4, burn_budget=0.5)
+    m.register("hog")
+    for _ in range(4):
+        m.record_violation("hog", 429)
+    c = obs.snapshot()["counters"]
+    assert c.get("health.slo_burn.serve.model.hog") == 1
+
+
+# ---------------------------------------------------------------------------
+# multi-writer hammer (runs under --ytk-lockwatch in CI)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.threaded
+def test_threaded_multi_writer_hammer(obs_on):
+    m = mm.ModelMetrics(slo_ms=0.0, max_models=3)
+    names = ["a", "b", "c", "ghost-1", "ghost-2"]  # 2 land in overflow
+    n_threads, per_thread = 8, 200
+    errs = []
+
+    def work(tid):
+        try:
+            for i in range(per_thread):
+                name = names[(tid + i) % len(names)]
+                m.register(name)
+                m.record_request(name, 1, float(i % 7))
+                if i % 10 == 0:
+                    m.record_not_found("nope")
+                    m.snapshot()  # readers race the writers
+        except Exception as e:  # noqa: BLE001 — the assertion IS no-exception
+            errs.append(e)
+
+    threads = [threading.Thread(target=work, args=(t,)) for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30.0)
+    assert not errs
+    c = obs.snapshot()["counters"]
+    total = sum(v for k, v in c.items()
+                if k.startswith("serve.model.") and k.endswith(".requests"))
+    assert total == n_threads * per_thread  # no lost increments
+    # WHICH 3 names won admission is a race; the cap itself is not
+    assert len(m.names()) == 3 + 1 and mm.OVERFLOW in m.names()
+    for fam_name in m.names():
+        assert len(m.family(fam_name).ring) <= mm.RING_N
+
+
+# ---------------------------------------------------------------------------
+# conservation through ServeApp: per-model sums == global twins, exactly
+# ---------------------------------------------------------------------------
+
+
+def test_serveapp_conservation_and_models_payload(tmp_path, obs_on):
+    app, reg = _two_model_app(tmp_path, cache_rows=64)
+    try:
+        row = {"c0": 1.0, "c1": 2.0}
+        for i in range(4):
+            app.predict([{"c0": float(i)}], model="alpha", timeout=10.0)
+        for _ in range(3):
+            app.predict([row, row], model="beta", timeout=10.0)
+        out = app.predict([row, row], model="beta", timeout=10.0)  # cache hit
+        assert out.get("cached") is True
+        with pytest.raises(KeyError):
+            app.predict([row], model="nope", timeout=10.0)
+
+        payload = app.metrics_payload(models=True)
+        block = payload["model_metrics"]
+        models = block["models"]
+        g = payload["counters"]
+        # the conservation identity, per counter pair (exact, not approx)
+        assert sum(b["counters"].get("requests", 0)
+                   for b in models.values()) == g["serve.requests"]
+        assert sum(b["counters"].get("request_rows", 0)
+                   for b in models.values()) == g["serve.request_rows"]
+        assert sum(b["counters"].get("cache.hit", 0)
+                   for b in models.values()) == g["serve.cache.hit"]
+        assert sum(b["counters"].get("cache.miss", 0)
+                   for b in models.values()) == g["serve.cache.miss"]
+        # the 404 landed in overflow, not a new family
+        assert models["__overflow__"]["counters"]["not_found"] == 1
+        assert set(models) == {"__overflow__", "alpha", "beta"}
+        # per-scope cache occupancy rides the block
+        assert models["beta"]["cache_rows"] >= 1
+        assert models["alpha"]["latency"]["count"] == 4
+    finally:
+        _close(app, reg)
+
+
+def test_batcher_mirrors_shed_and_expiry_per_model(obs_on):
+    gate = threading.Event()
+
+    def score_fn(rows):
+        gate.wait(10.0)
+        return [0.0] * len(rows), [0.0] * len(rows), None
+
+    b = MicroBatcher(
+        score_fn, BatchPolicy(max_batch=4, max_wait_ms=0.1, max_queue=2),
+        model_scope="hog",
+    )
+    try:
+        p0 = b.submit([{"x": 1.0}])          # loop picks this up, blocks
+        time.sleep(0.1)
+        p1 = b.submit([{"x": 2.0}], deadline_ms=1e-3)  # queued; will expire
+        with pytest.raises(OverloadError):
+            for _ in range(10):
+                b.submit([{"x": 3.0}, {"x": 4.0}, {"x": 5.0}])
+        gate.set()
+        p0.get(10.0)
+        with pytest.raises(DeadlineExceeded):
+            p1.get(10.0)
+    finally:
+        gate.set()
+        b.close(drain=True)
+    c = obs.snapshot()["counters"]
+    assert c["serve.shed"] == c["serve.model.hog.shed"] >= 1
+    assert c["serve.deadline_expired"] == c["serve.model.hog.deadline_expired"] == 1
+
+
+# ---------------------------------------------------------------------------
+# model-aware 429 Retry-After
+# ---------------------------------------------------------------------------
+
+
+def test_retry_after_uses_named_models_own_queue_and_rate(tmp_path, obs_on):
+    app, reg = _two_model_app(tmp_path)
+    try:
+        for i in range(6):
+            app.predict([{"c0": float(i)}], model="alpha", timeout=10.0)
+        app.batcher_for("beta")  # exists, but no drain evidence yet
+        # alpha: empty queue ÷ healthy rate -> the 1s floor
+        assert app.retry_after_s("alpha") == 1
+        # beta: its OWN empty rate window -> the honest worst case, even
+        # though the process-global window is hot (the bug this fixes:
+        # a cold model borrowing the hot model's drain rate)
+        assert app.retry_after_s("beta") == RETRY_AFTER_MAX_S
+        # unknown / unnamed -> the global aggregate fallback
+        assert app.retry_after_s("nope") == app.retry_after_s(None)
+    finally:
+        _close(app, reg)
+
+
+# ---------------------------------------------------------------------------
+# satellite: one entry resolution per payload (no intra-scrape blending)
+# ---------------------------------------------------------------------------
+
+
+class _SwapScorer:
+    def __init__(self, rung):
+        self.ladder = (1,)
+        self._rung = rung
+
+    def rung_info(self):
+        return {"rung": self._rung}
+
+
+class _SwapEntry:
+    def __init__(self, version, rung):
+        self.version = version
+        self.scorer = _SwapScorer(rung)
+
+
+class _SwappingRegistry:
+    """Every get() returns the NEXT version — the worst-case hot-reload
+    race: any payload reading a model's fields via two get() calls WILL
+    blend versions."""
+
+    def __init__(self):
+        self.gets = 0
+
+    def names(self):
+        return ["m"]
+
+    def get(self, name):
+        self.gets += 1
+        return _SwapEntry(self.gets, rung=self.gets * 10)
+
+    def pinned(self, name):
+        return False
+
+    def __len__(self):
+        return 1
+
+
+def test_metrics_payload_resolves_each_entry_once(tmp_path, obs_on):
+    app, reg = _two_model_app(tmp_path)
+    try:
+        swap = _SwappingRegistry()
+        app.registry = swap
+        payload = app.metrics_payload(models=True)
+        m = payload["models"]["m"]
+        # version and rung came from ONE entry: version k pairs with
+        # rung 10k by construction, any blend breaks the pairing
+        assert m["rung"]["rung"] == m["version"] * 10
+        assert swap.gets == 1  # the whole payload resolved "m" once
+        swap.gets = 0
+        app.health_payload()
+        assert swap.gets == 1
+    finally:
+        app.registry = reg
+        _close(app, reg)
+
+
+# ---------------------------------------------------------------------------
+# per-scope cache occupancy
+# ---------------------------------------------------------------------------
+
+
+def test_cache_scope_occupancy_tracks_store_and_evict(obs_on):
+    cache = PredictionCache(max_rows=3)
+    mk = ("fp", 1)
+    cache.store(mk, [{"r": 1.0}, {"r": 2.0}], [0.1, 0.2], [1, 2], scope="a")
+    cache.store(mk, [{"r": 3.0}], [0.3], [3], scope="b")
+    assert cache.scope_rows() == {"a": 2, "b": 1}
+    # eviction re-credits the EVICTED key's scope (oldest = a's rows)
+    cache.store(mk, [{"r": 4.0}], [0.4], [4], scope="b")
+    assert cache.scope_rows() == {"a": 1, "b": 2}
+    # re-store of a live key under a new scope re-attributes it
+    cache.store(mk, [{"r": 2.0}], [0.2], [2], scope="b")
+    assert cache.scope_rows() == {"b": 3}
+    cache.clear()
+    assert cache.scope_rows() == {}
+
+
+# ---------------------------------------------------------------------------
+# /metrics?models=1 over HTTP
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_models_param_http(tmp_path, obs_on):
+    app, reg = _two_model_app(tmp_path)
+    app.start()
+    try:
+        for i in range(3):
+            _http("POST", app.port, "/predict",
+                  {"rows": [{"c0": float(i)}], "model": "alpha"})
+        code, plain = _http("GET", app.port, "/metrics")
+        assert code == 200 and "model_metrics" not in plain
+        code, out = _http("GET", app.port, "/metrics?models=1&raw=1")
+        assert code == 200
+        block = out["model_metrics"]
+        alpha = block["models"]["alpha"]
+        assert alpha["counters"]["requests"] == 3
+        assert alpha["latency"]["count"] == 3
+        assert isinstance(alpha["latency"]["raw_ms"], list)
+        # loaded-but-quiet models still show up in the table
+        assert block["models"]["beta"]["latency"]["count"] == 0
+    finally:
+        app.stop(drain=True, timeout=10.0)
+        reg.close()
+
+
+# ---------------------------------------------------------------------------
+# YTK_OBS=0: the cached no-op contract
+# ---------------------------------------------------------------------------
+
+
+def test_obs_off_records_no_counters():
+    obs.configure(enabled=False)
+    obs.reset()
+    m = mm.ModelMetrics(slo_ms=0.0, max_models=4)
+    m.register("a")
+    m.record_request("a", 5, 1.0)
+    m.record_not_found("nope")
+    snap = m.snapshot()
+    assert snap["models"]["a"]["counters"] == {}
+    assert not obs.snapshot()["counters"]
+    # the ring still works (it's process-local state, not an obs counter)
+    assert snap["models"]["a"]["latency"]["count"] == 1
+
+
+# ---------------------------------------------------------------------------
+# flight dumps name the tenant
+# ---------------------------------------------------------------------------
+
+
+def test_flight_dump_carries_model_block(obs_on, tmp_path, monkeypatch):
+    import json
+
+    from ytklearn_tpu.obs import recorder
+
+    monkeypatch.setenv("YTK_FLIGHT_DIR", str(tmp_path))
+    m = mm.ModelMetrics(slo_ms=0.0, max_models=4)
+    m.register("tenant")
+    m.record_request("tenant", 3, 1.5)
+    mm.set_default(m)
+    try:
+        path = recorder.dump(reason="test")
+        with open(path) as f:
+            doc = json.load(f)
+        block = doc["flight"]["model_metrics"]
+        assert block["models"]["tenant"]["counters"]["requests"] == 1
+        assert block["models"]["tenant"]["latency"]["count"] == 1
+    finally:
+        mm.set_default(None)
+
+
+# ---------------------------------------------------------------------------
+# fleet merge (pure function)
+# ---------------------------------------------------------------------------
+
+
+def _replica_block(now, models):
+    out = {}
+    for name, (samples, counters, fired, cache_rows) in models.items():
+        out[name] = {
+            "counters": counters,
+            "latency": {
+                "count": len(samples),
+                "raw_ms": [[now - 1.0, s] for s in samples],
+            },
+            "slo": {"slo_ms": 10.0, "windows_fired": fired},
+            "cache_rows": cache_rows,
+        }
+    return {"models": out}
+
+
+def test_merge_model_metrics_unions_rings_and_ranks_talkers():
+    now = time.time()
+    blocks = {
+        "0": _replica_block(now, {
+            "hog": ([5.0, 6.0, 7.0], {"requests": 10, "request_rows": 100}, 2, 8),
+            "calm": ([1.0], {"requests": 4, "request_rows": 4}, 0, 2),
+        }),
+        "1": _replica_block(now, {
+            "hog": ([8.0, 9.0], {"requests": 5, "request_rows": 50}, 1, 4),
+        }),
+    }
+    out = merge_model_metrics(blocks, now)
+    hog = out["models"]["hog"]
+    # the fleet percentile is over the UNION of both replicas' rings
+    assert hog["latency"]["count"] == 5
+    assert hog["latency"]["max_ms"] == 9.0
+    assert hog["counters"] == {"requests": 15, "request_rows": 150}
+    assert hog["slo"]["windows_fired"] == 3
+    assert hog["cache_rows"] == 12
+    assert set(hog["replicas"]) == {"0", "1"}
+    assert hog["replicas"]["1"]["slo"]["windows_fired"] == 1
+    talkers = out["top_talkers"]
+    assert [t["model"] for t in talkers] == ["hog", "calm"]
+    assert talkers[0]["share"] == pytest.approx(150 / 154, abs=1e-3)
+    # stale samples (outside the union window) never dilute the fleet view
+    stale = {"0": _replica_block(now - 3600, {
+        "hog": ([5.0], {"requests": 1, "request_rows": 1}, 0, 0)})}
+    assert merge_model_metrics(stale, now)["models"]["hog"]["latency"]["count"] == 0

@@ -223,16 +223,21 @@ def test_serveapp_slo_burn_fires_and_is_strict_escalatable(
         for i in range(8):
             app.predict([{"c0": float(i)}], timeout=10.0)
         snap = obs.snapshot()["counters"]
-        assert snap.get("health.slo_burn") == 1
+        # the aggregate counts BOTH sentinels that watched this traffic:
+        # the request-level one and the per-model one naming "default"
+        assert snap.get("health.slo_burn") == 2
         assert snap.get("health.slo_burn.serve.predict") == 1
+        assert snap.get("health.slo_burn.serve.model.default") == 1
         ev = [e for e in obs.REGISTRY.events
               if e.get("name") == "health.slo_burn"]
         assert ev and ev[-1]["args"]["rate"] == 1.0
         assert ev[-1]["args"]["window"] == 8
-        # window re-arms: a second full window fires again
+        # window re-arms: a second full window fires again (both sites)
         for i in range(8):
             app.predict([{"c0": float(i)}], timeout=10.0)
-        assert obs.snapshot()["counters"]["health.slo_burn"] == 2
+        snap = obs.snapshot()["counters"]
+        assert snap["health.slo_burn"] == 4
+        assert snap["health.slo_burn.serve.predict"] == 2
     finally:
         _close(app, reg)
 

@@ -501,6 +501,12 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--name", default="default",
                     help="registry name for this model (the default target "
                     "of /predict requests without a \"model\" field)")
+    ap.add_argument("--extra-model", action="append", default=[],
+                    metavar="NAME:MODEL_NAME:CONFIG_PATH",
+                    help="load an additional model into the registry "
+                    "(repeatable) — multi-model serving from one process; "
+                    "requests address it via the \"model\" field. In fleet "
+                    "mode every replica loads every model")
     ap.add_argument("--ladder", default="",
                     help='compiled batch-shape ladder, e.g. "1,8,64,512" '
                     "(default; env YTK_SERVE_LADDER). Every rung compiles "
@@ -588,6 +594,17 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
     ladder = parse_ladder(args.ladder) if args.ladder else None
     registry = ModelRegistry(ladder=ladder, watch_interval_s=args.watch_interval)
     registry.load(args.name, args.model_name, cfg)
+    for spec in args.extra_model:
+        try:
+            xname, xmodel, xconf = spec.split(":", 2)
+        except ValueError:
+            ap.error(f"--extra-model {spec!r}: expected "
+                     "NAME:MODEL_NAME:CONFIG_PATH")
+        if xmodel not in MODEL_NAMES:
+            ap.error(f"--extra-model {spec!r}: unknown model family "
+                     f"{xmodel!r} (choices: {', '.join(MODEL_NAMES)})")
+        registry.load(xname, xmodel,
+                      _apply_overrides(hocon.load(xconf), args.sets))
     registry.start_watching()
     policy = BatchPolicy(
         max_batch=args.max_batch,
@@ -653,6 +670,10 @@ def _serve_fleet_main(args, replicas: int, slo_ms, cache_rows,
             worker_flags += [flag, str(val)]
     for s in args.sets or []:
         worker_flags += ["--set", s]
+    for spec in getattr(args, "extra_model", None) or []:
+        # every replica serves the full model set (shared-nothing fleet:
+        # any replica can answer any named-model request)
+        worker_flags += ["--extra-model", spec]
     if args.verbose:
         worker_flags.append("--verbose")
     front = FleetFront(

@@ -188,6 +188,13 @@ _knob("YTK_QUALITY_EVAL_S", "float", 5.0,
       "quality-evaluator tick interval in seconds: each tick drains the "
       "sampled-row buffers into the sketches, recomputes PSI/KS and "
       "calibration drift, and feeds the drift sentinels")
+_knob("YTK_MODEL_METRICS_MAX", "int", 32,
+      "named per-model metric-family budget for the mesh-obs accounting "
+      "plane (`serve.model.<name>.*` counters, latency rings, burn "
+      "sentinels); names past the budget — and 404 name floods — land "
+      "in the shared `__overflow__` bucket, so label cardinality is "
+      "bounded by construction — see "
+      "[observability.md](observability.md) \"Per-model accounting\"")
 
 # -- run health -------------------------------------------------------------
 _knob("YTK_HEALTH", "bool", True,
@@ -292,6 +299,12 @@ _knob("YTK_SERVE_SLO_MS", "float", 100.0,
       "serving p99 latency SLO in ms — the target the AIMD batch-size "
       "controller searches under (`0` disables the controller and "
       "restores the fixed `--max-batch`/`--max-wait-ms` knobs)")
+_knob("YTK_SERVE_SLO_MODELS", "str", None,
+      "per-model SLO overrides for the mesh-obs burn sentinels, "
+      "`name:ms,name2:ms` (e.g. `ctr:25,ranker:100`); listed models get "
+      "their own `health.slo_burn` budget at that SLO, unlisted models "
+      "inherit the app-wide `--slo-ms` default — see "
+      "[observability.md](observability.md) \"Per-model accounting\"")
 _knob("YTK_SERVE_CACHE_ROWS", "int", 0,
       "bounded LRU prediction-cache capacity in rows, keyed on (model "
       "fingerprint, feature-row hash); hits bypass the batcher queue and "

@@ -219,6 +219,18 @@ def _dump(reason: str, exc: Optional[BaseException]) -> str:
     # ytklint: allow(broad-except) reason=the flight dump must land even when the profiling plane is the broken part
     except Exception:
         pass
+    try:
+        from . import model_metrics as _model_metrics
+
+        mm = _model_metrics.flight_block()
+        if mm is not None:
+            # a serving postmortem names the tenant: per-model counters,
+            # latency percentiles, and burn-sentinel state (None — and
+            # absent — outside a serving process)
+            flight["model_metrics"] = mm
+    # ytklint: allow(broad-except) reason=the flight dump must land even when the per-model plane is the broken part
+    except Exception:
+        pass
 
     _state.dump_seq += 1
     ts = time.strftime("%Y%m%d-%H%M%S")

@@ -185,6 +185,7 @@ class MicroBatcher:
         policy: Optional[BatchPolicy] = None,
         controller=None,
         trace_site: str = "serve",
+        model_scope: Optional[str] = None,
     ):
         self.score_fn = score_fn
         self.policy = policy or BatchPolicy()
@@ -192,6 +193,12 @@ class MicroBatcher:
         # "serve" inside a replica/solo server, "front" for the fleet
         # front's per-replica forwarders (queue hop = f"{site}.queue")
         self.trace_site = trace_site
+        # mesh-obs family scope (obs/model_metrics.py): when set, the shed
+        # and deadline-expiry counters are mirrored per model at the SAME
+        # sites as their global twins — the exact-conservation identity
+        # (sum over `serve.model.*.shed` == `serve.shed`) holds because no
+        # other code path increments either
+        self.model_scope = model_scope
         # optional AIMD batch-size controller (serve/fleet/aimd.py): when
         # set, it supplies max_batch/max_wait_ms live (snapped to the
         # compiled ladder) and is fed per-request latencies by the worker;
@@ -236,6 +243,8 @@ class MicroBatcher:
                 raise ServeClosed("serve batcher is draining")
             if len(self._queue) >= self.policy.max_queue:
                 obs_inc("serve.shed")
+                if self.model_scope is not None:
+                    obs_inc(f"serve.model.{self.model_scope}.shed")
                 raise OverloadError(
                     f"serve queue full ({self.policy.max_queue} pending)"
                 )
@@ -314,6 +323,11 @@ class MicroBatcher:
                     )
                 if req.deadline is not None and now > req.deadline:
                     obs_inc("serve.deadline_expired")
+                    if self.model_scope is not None:
+                        obs_inc(
+                            f"serve.model.{self.model_scope}"
+                            ".deadline_expired"
+                        )
                     req.error = DeadlineExceeded(
                         f"deadline expired after "
                         f"{(now - req.t_enq) * 1e3:.1f} ms in queue"

@@ -52,6 +52,12 @@ class PredictionCache:
             max_rows = knobs.get_int("YTK_SERVE_CACHE_ROWS")
         self.max_rows = max(0, int(max_rows))
         self._lru: OrderedDict = OrderedDict()
+        # mesh-obs per-model occupancy: which family scope stored each
+        # key (maintained with _lru under the same lock), and the live
+        # row count per scope — `/metrics?models=1` reports who actually
+        # owns the shared cache budget
+        self._key_scope: dict = {}
+        self._scope_rows: Dict[str, int] = {}
         self._lock = threading.Lock()
 
     @property
@@ -67,14 +73,16 @@ class PredictionCache:
         return (entry.fingerprint, entry.version)
 
     def lookup(
-        self, model_key: tuple, rows: Sequence[Dict[str, float]]
+        self, model_key: tuple, rows: Sequence[Dict[str, float]],
+        scope: Optional[str] = None,
     ) -> Optional[list]:
         """All-or-nothing: the per-row (score, pred) list when EVERY row
         hits, else None (partial hits still ride the scored path, so a
         response is always one model version end to end). Both counters
         are in ROWS — hit rows bypassed the scorer, miss rows rode the
         scored path — so hit/(hit+miss) is a true row hit rate even for
-        multi-row requests."""
+        multi-row requests. `scope` (a mesh-obs family name) mirrors each
+        counter per model at the same site as its global twin."""
         if not self.enabled:
             return None
         out = []
@@ -84,16 +92,26 @@ class PredictionCache:
                 hit = self._lru.get(k)
                 if hit is None:
                     obs_inc("serve.cache.miss", len(rows))
+                    if scope is not None:
+                        obs_inc(
+                            f"serve.model.{scope}.cache.miss", len(rows)
+                        )
                     return None
                 self._lru.move_to_end(k)
                 out.append(hit)
         obs_inc("serve.cache.hit", len(rows))
+        if scope is not None:
+            obs_inc(f"serve.model.{scope}.cache.hit", len(rows))
         return out
 
     def store(
-        self, model_key: tuple, rows: Sequence[Dict[str, float]], scores, preds
+        self, model_key: tuple, rows: Sequence[Dict[str, float]], scores,
+        preds, scope: Optional[str] = None,
     ) -> None:
-        """Insert scored rows (score_i, pred_i from the batch arrays)."""
+        """Insert scored rows (score_i, pred_i from the batch arrays).
+        `scope` attributes the stored rows to a mesh-obs family for the
+        per-model occupancy view; eviction re-credits the evicted key's
+        own scope, not the storer's."""
         if not self.enabled:
             return
         with self._lock:
@@ -108,16 +126,41 @@ class PredictionCache:
                     s = np.array(s, copy=True)
                 if isinstance(p, np.ndarray):
                     p = np.array(p, copy=True)
+                fresh = k not in self._lru
                 self._lru[k] = (s, p)
                 self._lru.move_to_end(k)  # re-stored keys keep recency
+                if scope is not None:
+                    old = self._key_scope.get(k)
+                    if fresh or old != scope:
+                        if old is not None and not fresh:
+                            self._scope_rows[old] = (
+                                self._scope_rows.get(old, 1) - 1
+                            )
+                        self._key_scope[k] = scope
+                        self._scope_rows[scope] = (
+                            self._scope_rows.get(scope, 0) + 1
+                        )
             evicted = 0
             while len(self._lru) > self.max_rows:
-                self._lru.popitem(last=False)
+                k, _ = self._lru.popitem(last=False)
+                old = self._key_scope.pop(k, None)
+                if old is not None:
+                    left = self._scope_rows.get(old, 1) - 1
+                    if left > 0:
+                        self._scope_rows[old] = left
+                    else:
+                        self._scope_rows.pop(old, None)
                 evicted += 1
             n = len(self._lru)
         if evicted:
             obs_inc("serve.cache.evict", evicted)
         obs_gauge("serve.cache.rows", n)
+
+    def scope_rows(self) -> Dict[str, int]:
+        """Live cached-row count per mesh-obs family scope (rows stored
+        without a scope are not attributed)."""
+        with self._lock:
+            return {s: n for s, n in sorted(self._scope_rows.items()) if n > 0}
 
     def __len__(self) -> int:
         with self._lock:
@@ -126,6 +169,8 @@ class PredictionCache:
     def clear(self) -> None:
         with self._lock:
             self._lru.clear()
+            self._key_scope.clear()
+            self._scope_rows.clear()
         obs_gauge("serve.cache.rows", 0)
 
 

@@ -208,6 +208,70 @@ def window_ring_ms(
     return out
 
 
+def merge_model_metrics(
+    replica_blocks: Dict[str, dict], now: float
+) -> dict:
+    """Fleet per-model table from replica `model_metrics` blocks
+    (`/metrics?raw=1&models=1`): per-model latency rings UNION across
+    replicas — windowed on sample timestamps like the process-level
+    union, keyed by model — plus summed scoped counters, summed
+    sentinel fires, per-replica latency sub-blocks, and a top-talker
+    ranking by served rows. Pure function (unit-testable without a
+    fleet); the same shape renders in obs_report."""
+    models: Dict[str, dict] = {}
+    for rid, block in sorted(replica_blocks.items()):
+        for name, mb in ((block or {}).get("models") or {}).items():
+            agg = models.get(name)
+            if agg is None:
+                agg = models[name] = {
+                    "_ring": [], "counters": {}, "replicas": {},
+                }
+            lat = dict(mb.get("latency") or {})
+            agg["_ring"].extend(
+                window_ring_ms(lat.pop("raw_ms", None) or [], now)
+            )
+            for k, v in (mb.get("counters") or {}).items():
+                agg["counters"][k] = round(
+                    agg["counters"].get(k, 0.0) + v, 3
+                )
+            rep = {"latency": lat}
+            if "cache_rows" in mb:
+                agg["cache_rows"] = (
+                    agg.get("cache_rows", 0) + mb["cache_rows"]
+                )
+                rep["cache_rows"] = mb["cache_rows"]
+            slo = mb.get("slo")
+            if slo:
+                fleet_slo = agg.setdefault(
+                    "slo", {"slo_ms": slo.get("slo_ms"),
+                            "windows_fired": 0}
+                )
+                fleet_slo["windows_fired"] += int(
+                    slo.get("windows_fired") or 0
+                )
+                rep["slo"] = slo
+            agg["replicas"][str(rid)] = rep
+    out_models: Dict[str, dict] = {}
+    talkers = []
+    for name in sorted(models):
+        agg = models[name]
+        # fleet percentile over the windowed union — a fleet number,
+        # not replica-0's and not an average of per-replica p99s
+        agg["latency"] = latency_percentiles(agg.pop("_ring"))
+        out_models[name] = agg
+        talkers.append({
+            "model": name,
+            "requests": agg["counters"].get("requests", 0.0),
+            "request_rows": agg["counters"].get("request_rows", 0.0),
+        })
+    talkers.sort(key=lambda t: (-t["request_rows"], -t["requests"],
+                                t["model"]))
+    total = sum(t["request_rows"] for t in talkers)
+    for t in talkers:
+        t["share"] = round(t["request_rows"] / total, 4) if total else 0.0
+    return {"models": out_models, "top_talkers": talkers}
+
+
 class FleetFront:
     """Owns the replica fleet; predict()/admin()/metrics_payload() are the
     API, start()/stop() the lifecycle, serve_http() the listener."""
@@ -1015,7 +1079,8 @@ class FleetFront:
         }
 
     def _scrape_replica(self, rid: int, h: ReplicaHandle,
-                        quality: bool = False, prof: bool = False) -> dict:
+                        quality: bool = False, prof: bool = False,
+                        models: bool = False) -> dict:
         info = {
             "replica_id": rid,
             "pid": h.pid,
@@ -1027,7 +1092,8 @@ class FleetFront:
         if h.state != "ready":
             return info
         path = ("/metrics?raw=1" + ("&quality=1" if quality else "")
-                + ("&prof=1" if prof else ""))
+                + ("&prof=1" if prof else "")
+                + ("&models=1" if models else ""))
         try:
             # quality scrapes carry serialized sketches + run an eval on
             # the replica — give them more room than the 2s liveness poll
@@ -1051,6 +1117,11 @@ class FleetFront:
                 # the replica answers even with the plane off — then the
                 # block says enabled:false with empty rung tables)
                 info["prof"] = m["prof"]
+            if models and "model_metrics" in m:
+                # mesh-obs per-model block (raw rings included — the
+                # scrape path carries &raw=1); metrics_payload merges
+                # these fleet-wide, keyed by model
+                info["model_metrics"] = m["model_metrics"]
             counters = m.get("counters") or {}
             info["counters"] = {
                 k: v for k, v in counters.items()
@@ -1060,7 +1131,8 @@ class FleetFront:
         return info
 
     def metrics_payload(self, history: bool = False,
-                        quality: bool = False, prof: bool = False) -> dict:
+                        quality: bool = False, prof: bool = False,
+                        models: bool = False) -> dict:
         per: Dict[str, dict] = {}
         ring_union: List[float] = []
         now = time.time()
@@ -1073,7 +1145,7 @@ class FleetFront:
 
         def _scrape(rid, h):
             results[rid] = self._scrape_replica(
-                rid, h, quality=quality, prof=prof
+                rid, h, quality=quality, prof=prof, models=models
             )
 
         scrapers = [
@@ -1085,6 +1157,7 @@ class FleetFront:
         for t in scrapers:
             t.join(timeout=15.0 if quality else 5.0)
         replica_quality: Dict[str, dict] = {}
+        replica_models: Dict[str, dict] = {}
         for rid, h in handles:
             total_restarts += h.restarts
             info = results.get(rid) or {
@@ -1101,6 +1174,9 @@ class FleetFront:
             q = info.pop("quality", None)
             if q:
                 replica_quality[str(rid)] = q
+            mm = info.pop("model_metrics", None)
+            if mm:
+                replica_models[str(rid)] = mm
             per[str(rid)] = info
         snap = obs_snapshot()
         out = {
@@ -1144,6 +1220,10 @@ class FleetFront:
             from ...obs.quality import merge_quality_payloads
 
             out["quality"] = merge_quality_payloads(replica_quality)
+        if models:
+            # mesh-obs fleet table (`/metrics?models=1`): per-model ring
+            # union keyed by model + summed counters + top-talker ranking
+            out["model_metrics"] = merge_model_metrics(replica_models, now)
         return out
 
     def traces_payload(self) -> dict:
@@ -1230,8 +1310,10 @@ class FleetFront:
                     hist = query.get("history", ["0"])[0] not in ("0", "")
                     qual = query.get("quality", ["0"])[0] not in ("0", "")
                     prof = query.get("prof", ["0"])[0] not in ("0", "")
+                    mdl = query.get("models", ["0"])[0] not in ("0", "")
                     self._json(200, front.metrics_payload(
-                        history=hist, quality=qual, prof=prof))
+                        history=hist, quality=qual, prof=prof,
+                        models=mdl))
                 elif path == "/admin/traces":
                     self._json(200, front.traces_payload())
                 else:

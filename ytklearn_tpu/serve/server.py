@@ -18,7 +18,10 @@ Endpoints (JSON in/out):
   GET /metrics     obs registry snapshot + request latency p50/p99/p999,
                    queue depth, per-model versions; `?raw=1` adds the
                    (ts, ms) latency-ring samples (fleet union input),
-                   `?history=1` adds the per-metric time-series rings
+                   `?history=1` adds the per-metric time-series rings,
+                   `?models=1` adds the mesh-obs per-model accounting
+                   table (scoped counters, latency, burn-sentinel state,
+                   cache occupancy, prof attribution)
   GET /admin/traces  the request-trace exemplar ring: head-sampled +
                    tail-retained (shed/504/SLO-violating) per-hop traces
                    (obs/trace.py, YTK_TRACE_SAMPLE)
@@ -49,6 +52,7 @@ import numpy as np
 
 from ..obs import enabled as obs_enabled, inc as obs_inc, snapshot as obs_snapshot, span as obs_span
 from ..obs import health as obs_health
+from ..obs import model_metrics as obs_models
 from ..obs import quality as obs_quality
 from ..obs import trace as obs_trace
 from ..obs.core import REGISTRY as OBS_REGISTRY
@@ -139,14 +143,25 @@ class ServeApp:
         if slo_ms and slo_ms > 0:
             obs_trace.configure_tracing(slo_ms=slo_ms)
         self.latency = _LatencyWindow()
+        # mesh-obs per-model accounting plane (obs/model_metrics.py):
+        # bounded scoped families — counters, latency rings, and burn
+        # sentinels keyed by model name, fed at the SAME sites as their
+        # global twins (exact conservation). Published as the process
+        # default so flight dumps carry the per-model block.
+        self.models = obs_models.ModelMetrics(slo_ms=slo_ms)
+        for _n in registry.names():
+            self.models.register(_n)
+        obs_models.set_default(self.models)
         # model-quality monitor (obs/quality.py): the predict path feeds
         # sampled rows + predictions into per-model drift sketches; the
         # evaluator thread (armed in start()) judges them against each
         # model's training sidecar. YTK_QUALITY_SAMPLE=0 disables.
         self.quality = obs_quality.default_monitor()
         # recent scored-rows/s (success path) -> the 429 Retry-After
-        # queue-drain estimate (same arithmetic as the fleet front)
+        # queue-drain estimate (same arithmetic as the fleet front);
+        # per-model windows back the model-aware Retry-After hint
         self._scored = ScoredRateWindow()
+        self._scored_by_model: Dict[str, ScoredRateWindow] = {}
         self.draining = False
         self._batchers: Dict[str, MicroBatcher] = {}
         self._batchers_lock = threading.Lock()
@@ -175,9 +190,24 @@ class ServeApp:
                     controller = maybe_controller(
                         self.registry.get(name).scorer.ladder, self.slo_ms
                     )
-                b = MicroBatcher(score_fn, self.policy, controller=controller)
+                b = MicroBatcher(
+                    score_fn, self.policy, controller=controller,
+                    # shed/expiry counters mirrored per model at the
+                    # batcher's own sites (mesh-obs conservation)
+                    model_scope=self.models.register(name),
+                )
                 self._batchers[name] = b
             return b
+
+    def _rate_for(self, name: str) -> ScoredRateWindow:
+        """Per-model scored-rows/s window (model-aware Retry-After)."""
+        r = self._scored_by_model.get(name)
+        if r is None:
+            with self._batchers_lock:
+                r = self._scored_by_model.get(name)
+                if r is None:
+                    r = self._scored_by_model[name] = ScoredRateWindow()
+        return r
 
     def _request_done(self, ms: float) -> None:
         """Per-request bookkeeping shared by every completion path."""
@@ -185,13 +215,25 @@ class ServeApp:
         if self.slo_burn is not None:
             self.slo_burn.observe(ms)
 
-    def retry_after_s(self) -> int:
+    def retry_after_s(self, model: Optional[str] = None) -> int:
         """429 Retry-After hint: queued rows ÷ recent scored-rows/s
         (clamped to a small bound) — how long the queue actually needs
-        to drain before a retry has a chance."""
+        to drain before a retry has a chance. When the request named a
+        model the estimate uses THAT model's own queue depth and drain
+        rate: queues drain per batcher, so a cold model's queue behind a
+        hot model would otherwise borrow the hot model's rate and be
+        wrong by the traffic ratio. Global aggregate is the fallback."""
         with self._batchers_lock:
-            batchers = list(self._batchers.values())
-        backlog = sum(b.queued_rows for b in batchers)
+            batchers = dict(self._batchers)
+            rates = dict(self._scored_by_model)
+        if model and model in batchers:
+            # the model's own window; empty (no drain evidence yet) ->
+            # the clamp bound, the honest worst case
+            rate = rates.get(model)
+            if rate is None:
+                rate = ScoredRateWindow()
+            return retry_after_s(batchers[model].queued_rows, rate)
+        backlog = sum(b.queued_rows for b in batchers.values())
         return retry_after_s(backlog, self._scored)
 
     def _request_errored(self, status: int) -> None:
@@ -227,7 +269,15 @@ class ServeApp:
         if not names:
             raise KeyError("no models loaded")
         name = model or names[0]
-        entry = self.registry.get(name)  # 404 before enqueue for bad names
+        try:
+            entry = self.registry.get(name)  # 404 before enqueue for bad names
+        except KeyError:
+            # unknown-name accounting lands in the bounded __overflow__
+            # family (only registry-loaded names get their own) — a 404
+            # name-flood moves one counter, never the family map
+            self.models.record_not_found(name)
+            raise
+        scope = self.models.register(name)
         # fleet restart drill: kind=kill here takes this replica down
         # mid-request, exactly like a hardware loss under load
         chaos_point("serve.worker")
@@ -237,7 +287,7 @@ class ServeApp:
         try:
             cache = self.cache
             if cache is not None:
-                hit = cache.lookup(cache.model_key(entry), rows)
+                hit = cache.lookup(cache.model_key(entry), rows, scope=scope)
                 ctx.hop_at("serve.cache", t0, time.perf_counter(),
                            hit=hit is not None, rows=len(rows))
                 if hit is not None:
@@ -249,6 +299,7 @@ class ServeApp:
                     self._request_done(ms)
                     obs_inc("serve.requests")
                     obs_inc("serve.request_rows", len(rows))
+                    self.models.record_request(name, len(rows), ms)
                     preds_hit = np.asarray([h[1] for h in hit])
                     # cache hits are served traffic: the drift sketches
                     # must see the distribution clients actually send
@@ -273,12 +324,14 @@ class ServeApp:
                 ctx.hop_at("serve.wake", pending.t_done, time.perf_counter())
         except OverloadError:
             self._request_errored(429)
+            self.models.record_violation(name, 429)
             if own:
                 obs_trace.finish(ctx, status=429, rows=len(rows),
                                  latency_ms=(time.perf_counter() - t0) * 1e3)
             raise
         except DeadlineExceeded:
             self._request_errored(504)
+            self.models.record_violation(name, 504)
             if own:
                 obs_trace.finish(ctx, status=504, rows=len(rows),
                                  latency_ms=(time.perf_counter() - t0) * 1e3)
@@ -301,8 +354,10 @@ class ServeApp:
         # scored-path completions only (a cache hit never drained the
         # queue): the Retry-After estimate wants the queue's drain rate
         self._scored.record(len(rows))
+        self._rate_for(name).record(len(rows))
         obs_inc("serve.requests")
         obs_inc("serve.request_rows", len(rows))
+        self.models.record_request(name, len(rows), ms)
         # version from the batch's own entry resolution — the response
         # must name the model that actually scored it, not whatever was
         # current at enqueue time (hot-reload race)
@@ -314,7 +369,8 @@ class ServeApp:
         if cache is not None:
             # keyed by the entry that ACTUALLY scored the batch: a swap
             # landing between submit and score must not mislabel rows
-            cache.store(cache.model_key(entry), rows, scores, preds)
+            cache.store(cache.model_key(entry), rows, scores, preds,
+                        scope=scope)
         if own:
             obs_trace.finish(ctx, status=200, latency_ms=ms, rows=len(rows))
         return {
@@ -335,14 +391,28 @@ class ServeApp:
             and all(not b.closed for b in batchers)
         )
 
+    def _entry_snapshot(self) -> dict:
+        """{name: entry} resolved ONCE per model for a whole payload: a
+        scrape racing a hot-reload swap must read each model's fields
+        from one entry, never blend pre-swap `version` with post-swap
+        `rung` (the registry swaps atomically per name; repeated
+        `get(n)` calls inside one payload would not)."""
+        out = {}
+        for n in self.registry.names():
+            try:
+                out[n] = self.registry.get(n)
+            except KeyError:
+                continue  # unloaded between names() and get() — skip
+        return out
+
     def health_payload(self) -> dict:
         counters = obs_snapshot()["counters"]
         return {
             "status": "draining" if self.draining else "ok",
             "uptime_s": round(time.time() - self._started_at, 1),
             "models": {
-                n: {"version": self.registry.get(n).version}
-                for n in self.registry.names()
+                n: {"version": entry.version}
+                for n, entry in self._entry_snapshot().items()
             },
             "health_events": {
                 k: v for k, v in sorted(counters.items())
@@ -351,10 +421,14 @@ class ServeApp:
         }
 
     def metrics_payload(self, raw: bool = False, history: bool = False,
-                        quality: bool = False, prof: bool = False) -> dict:
+                        quality: bool = False, prof: bool = False,
+                        models: bool = False) -> dict:
         snap = obs_snapshot()
         with self._batchers_lock:  # batcher_for inserts concurrently
             batchers = dict(self._batchers)
+        # one entry per model for the WHOLE payload (models block, prof
+        # block, per-model plane): no intra-scrape hot-reload blending
+        entries = self._entry_snapshot()
         latency = self.latency.percentiles()
         if raw:
             # the fleet front merges replica rings (union windowed on the
@@ -378,14 +452,14 @@ class ServeApp:
             },
             "models": {
                 n: {
-                    "version": self.registry.get(n).version,
-                    "ladder": list(self.registry.get(n).scorer.ladder),
+                    "version": entry.version,
+                    "ladder": list(entry.scorer.ladder),
                     "pinned": self.registry.pinned(n),
                     # effective scoring rung + backend (fused/binned
                     # lowering evidence — serve_bench fleet records it)
-                    "rung": self.registry.get(n).scorer.rung_info(),
+                    "rung": entry.scorer.rung_info(),
                 }
-                for n in self.registry.names()
+                for n, entry in entries.items()
             },
             "counters": {k: round(v, 3) for k, v in sorted(snap["counters"].items())},
             "gauges": {k: round(v, 4) for k, v in sorted(snap["gauges"].items())},
@@ -393,6 +467,28 @@ class ServeApp:
         if self.cache is not None:
             out["cache"] = {"rows": len(self.cache),
                             "max_rows": self.cache.max_rows}
+        if models:
+            # mesh-obs per-model table (`/metrics?models=1`): scoped
+            # counters + latency percentiles (+ raw rings under &raw=1 —
+            # the fleet front's per-model union input) + sentinel state,
+            # joined with per-model cache occupancy and the r20 prof
+            # plane's per-model execute-time attribution
+            for n in entries:
+                self.models.register(n)  # loaded-but-quiet models show up
+            block = self.models.snapshot(raw=raw,
+                                         counters=snap["counters"])
+            if self.cache is not None:
+                occupancy = self.cache.scope_rows()
+                for s, mb in block["models"].items():
+                    mb["cache_rows"] = occupancy.get(s, 0)
+            from ..obs import profiler as obs_profiler
+
+            if obs_profiler.enabled():
+                for n, entry in entries.items():
+                    mb = block["models"].get(self.models.scope_name(n))
+                    if mb is not None:
+                        mb["prof"] = entry.scorer.prof_snapshot()
+            out["model_metrics"] = block
         if history:
             # metrics history plane: bounded per-metric (ts, value) rings
             # sampled by the obs heartbeat thread (YTK_OBS_HISTORY_N) —
@@ -415,8 +511,8 @@ class ServeApp:
             out["prof"] = {
                 "enabled": obs_profiler.enabled(),
                 "models": {
-                    n: self.registry.get(n).scorer.prof_snapshot()
-                    for n in self.registry.names()
+                    n: entry.scorer.prof_snapshot()
+                    for n, entry in entries.items()
                 },
                 "compile": obs_profiler.LEDGER.snapshot(limit=16),
                 "phases": obs_profiler.phases_snapshot(),
@@ -496,8 +592,10 @@ class ServeApp:
                     hist = query.get("history", ["0"])[0] not in ("0", "")
                     qual = query.get("quality", ["0"])[0] not in ("0", "")
                     prof = query.get("prof", ["0"])[0] not in ("0", "")
+                    mdl = query.get("models", ["0"])[0] not in ("0", "")
                     self._json(200, app.metrics_payload(
-                        raw=raw, history=hist, quality=qual, prof=prof))
+                        raw=raw, history=hist, quality=qual, prof=prof,
+                        models=mdl))
                 elif path == "/admin/traces":
                     # the per-process exemplar ring: head-sampled + tail-
                     # retained request traces (obs/trace.py); obs_report
@@ -561,10 +659,13 @@ class ServeApp:
                         )
                     except OverloadError as e:
                         # Retry-After: queue-drain estimate so a shed
-                        # client backs off intelligently (clamped)
+                        # client backs off intelligently (clamped);
+                        # model-aware when the request named one — the
+                        # named model's own queue and drain rate
                         _reply(429, {"error": str(e), "type": "overload"},
                                headers={"Retry-After":
-                                        str(app.retry_after_s())})
+                                        str(app.retry_after_s(
+                                            req.get("model")))})
                         return
                     except DeadlineExceeded as e:
                         _reply(504, {"error": str(e), "type": "deadline"})
