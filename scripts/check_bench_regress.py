@@ -16,11 +16,15 @@ Serve gate: SERVE_r*.json artifacts (scripts/serve_bench.py --record;
 schema "serve_latency", or "serve_rungs" whose artifact carries one
 record PER scoring rung) are compared on the latency axes that matter
 for serving — but ONLY between records with the same metric AND the same
-rung identity (fused, binned, precision): a binned-rung number vs a
-default-path number is an uplift, not a regression signal, exactly like
-the fleet gate's same-replica-count rule. Pre-rung artifacts count as
-the default rung, so the schema bump never breaks the gate; downgraded
-rung runs (a Mosaic fallback measured on its fallback path) skip.
+rung identity (fused, binned, precision) AND the same recorded host
+core count (`cpu_count`, absent on older artifacts): a binned-rung
+number vs a default-path number is an uplift, not a regression signal,
+exactly like the fleet gate's same-replica-count rule — and a 1-core
+container's req/s vs an 8-core box's is a hardware delta, not a code
+one. Pre-rung artifacts count as the default rung, so the schema bump
+never breaks the gate; downgraded rung runs (a Mosaic fallback measured
+on its fallback path) skip. The absolute gates below still apply to the
+newest artifact no matter what it pairs with.
 
   sustained req/s       new >= old * (1 - tol)
   p99 latency           new <= old * (1 + tol)   (the latency band)
@@ -39,6 +43,12 @@ predating the field skip cleanly.
 Quality-overhead gate: same shape for the model-quality plane's
 quality_overhead line (obs/quality.py row sampler at its default
 YTK_QUALITY_SAMPLE vs off); artifacts predating the field skip cleanly.
+
+Transform-overhead gate: the newest serve_rungs artifact's recorded
+transform_overhead line (ISSUE 19, docs/transform.md) is re-checked
+absolutely — the raw-feature-dict wire path must be bit-identical to
+pre-assembled vectors and hold zero steady-state retraces; artifacts
+predating the field skip cleanly.
 
 Fleet gate: schema "serve_fleet" artifacts (schema_version 2,
 `serve_bench.py --fleet`) are a different workload — N replica processes
@@ -218,6 +228,7 @@ def read_serve_records(path: str) -> List[dict]:
                 "p99_ms": entry.get("p99_ms"),
                 "retraces": entry.get("retraces_after_warmup"),
                 "downgraded": entry.get("downgraded", False),
+                "cpus": rec.get("cpu_count"),
                 "raw": rec,
             })
         return out
@@ -226,9 +237,13 @@ def read_serve_records(path: str) -> List[dict]:
 
 def serve_comparable_pairs(artifacts: List[Tuple[int, str]]):
     """[(old, new)] — for EVERY rung record in the newest serve artifact,
-    the nearest older record with the same (metric, rung). Rungs with no
-    same-rung predecessor (first artifact after a rung ships, or a
-    downgraded rung measured as its fallback) skip cleanly."""
+    the nearest older record with the same (metric, rung, host core
+    count). Rungs with no same-rung predecessor (first artifact after a
+    rung ships, a downgraded rung measured as its fallback, or no
+    predecessor recorded on same-size hardware — a 1-core container's
+    req/s vs an 8-core box's is not a regression signal) skip cleanly;
+    the absolute gates (quality bands, overhead lines, retraces) still
+    apply to the newest artifact regardless."""
     per_artifact = []
     for rnd, path in artifacts:
         try:
@@ -260,6 +275,7 @@ def serve_comparable_pairs(artifacts: List[Tuple[int, str]]):
                 (o for o in older
                  if o["metric"] == rec["metric"]
                  and o["rung"] == rec["rung"]
+                 and o.get("cpus") == rec.get("cpus")
                  and not o.get("downgraded")),
                 None,
             )
@@ -269,10 +285,14 @@ def serve_comparable_pairs(artifacts: List[Tuple[int, str]]):
                 )
                 break
         else:
-            print(
-                f"  [skip] r{n_rnd} rung {rec['label']}: no same-rung "
-                "predecessor"
+            rung_only = any(
+                o["metric"] == rec["metric"] and o["rung"] == rec["rung"]
+                and not o.get("downgraded")
+                for _, _, older in per_artifact[:-1] for o in older
             )
+            why = ("recorded on different hardware (core count)"
+                   if rung_only else "no same-rung predecessor")
+            print(f"  [skip] r{n_rnd} rung {rec['label']}: {why}")
     return pairs
 
 
@@ -459,6 +479,54 @@ def check_quality_overhead(
             ]
         return []
     print("  quality overhead: no serve_rungs artifact (skip)")
+    return []
+
+
+def check_transform_overhead(
+    artifacts: List[Tuple[int, str]]
+) -> List[str]:
+    """Absolute gate on the NEWEST serve_rungs artifact's recorded
+    transform-overhead line (ISSUE 19): the raw-feature-dict wire path
+    must score bit-identically to pre-assembled vectors and hold zero
+    steady-state retraces. Artifacts predating the field (r21 and
+    older) skip cleanly."""
+    import json
+
+    for rnd, path in reversed(artifacts):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if "parsed" in rec and "cmd" in rec:
+            rec = rec["parsed"] or {}
+        if rec.get("schema") != "serve_rungs":
+            continue
+        t = rec.get("transform_overhead") or {}
+        raw = t.get("raw_req_per_sec")
+        if raw is None:
+            print(f"  transform overhead: r{rnd} predates the field (skip)")
+            return []
+        fails = []
+        print(
+            f"  transform overhead (r{rnd}): raw {raw:.1f} vs assembled "
+            f"{t.get('assembled_req_per_sec', 0):.1f} req/s "
+            f"(+{t.get('transform_us_per_row', 0)}us/row, "
+            f"retraces={t.get('raw_retraces', 0)})"
+        )
+        if not t.get("assembled_bit_identical", True):
+            fails.append(
+                "raw-dict transform path not bit-identical to "
+                f"pre-assembled vectors in {os.path.basename(path)}"
+            )
+        if t.get("raw_retraces"):
+            fails.append(
+                f"{t['raw_retraces']} steady-state retrace(s) on the "
+                f"raw-dict transform path in {os.path.basename(path)} "
+                "(the batched pipeline is leaking shapes)"
+            )
+        return fails
+    print("  transform overhead: no serve_rungs artifact (skip)")
     return []
 
 
@@ -974,6 +1042,7 @@ def main(argv=None) -> int:
     fails += check_rung_quality(serve_artifacts)
     fails += check_tracing_overhead(serve_artifacts, tol=args.tol)
     fails += check_quality_overhead(serve_artifacts, tol=args.tol)
+    fails += check_transform_overhead(serve_artifacts)
 
     fleet_pair = fleet_comparable_pair(serve_artifacts)
     if fleet_pair is None:

@@ -29,7 +29,12 @@ recorded artifact and skips artifacts predating the field), plus the
 QUALITY-OVERHEAD line (`quality_overhead`, ISSUE 15): the same harness
 with the model-quality row sampler (obs/quality.py) off / at the
 default YTK_QUALITY_SAMPLE / always-on, evaluator thread running —
-the default rate is gated inside the same band.
+the default rate is gated inside the same band, plus the
+TRANSFORM-OVERHEAD line (`transform_overhead`, ISSUE 19): a hashed +
+transform-stat linear model served RAW feature dicts vs the same
+model fed pre-assembled vectors — per-row pipeline cost, bit-identity
+across the two paths, and zero steady-state retraces on the raw path
+(docs/transform.md; check_bench_regress re-gates the artifact).
 
 Model: the agaricus GBDT demo (trained on the spot) when /root/reference
 is present, else a synthetic ensemble in the same format. Emits one
@@ -609,6 +614,110 @@ def measure_quality_overhead(tmp_dir, pred, trees, rows, seconds, log) -> dict:
         out["sampled_over_off"] = round(out["sampled_req_per_sec"] / off, 4)
         out["always_over_off"] = round(out["always_req_per_sec"] / off, 4)
     log.info("quality overhead: %s", out)
+    return out
+
+
+def measure_transform_overhead(tmp_dir, rows_n, seconds, log) -> dict:
+    """The transform-pipeline overhead line (ISSUE 19): a hashed +
+    transform-stat linear model driven through the full ServeApp.predict
+    path on RAW named feature dicts (the wire contract, docs/
+    transform.md) vs the SAME model fed pre-assembled vectors (hashing
+    and stat replay already done client-side). The delta is the per-row
+    cost of running the feature pipeline inside the replica; the
+    raw-dict path must also be bit-identical to the assembled one and
+    hold zero steady-state retraces (gated in main, re-gated absolutely
+    by check_bench_regress)."""
+    from ytklearn_tpu import obs
+    from ytklearn_tpu.io.feature_hash import FeatureHash
+    from ytklearn_tpu.predict import create_predictor
+    from ytklearn_tpu.serve import (
+        BatchPolicy, CompiledScorer, ModelRegistry, ServeApp,
+    )
+    from ytklearn_tpu.serve.scorer import compile_credit
+
+    rng = np.random.RandomState(23)
+    prefix, hseed, buckets, n_raw = "fh", 17, 4096, 96
+    raw_names = [f"raw{i}" for i in range(n_raw)]
+    fh = FeatureHash(buckets, hseed, prefix)
+    hashed = sorted({fh.hash_name(nm)[0] for nm in raw_names})
+    path = os.path.join(tmp_dir, "bench_transform.model")
+    with open(path, "w") as f:
+        for nm in hashed:
+            f.write(f"{nm},{rng.randn():.6f},1.0\n")
+        f.write(f"_bias_,{rng.randn():.6f}\n")
+    with open(path + "_feature_transform_stat", "w") as f:
+        for nm in hashed:
+            f.write(
+                f"{nm}###mode=standardization, mean={rng.randn():.4f}, "
+                f"stdvar={0.5 + rng.rand():.4f}, max=10.0, min=-10.0, "
+                "rangeMax=1.0, rangeMin=-1.0\n"
+            )
+    raw_cfg = {
+        "model": {"data_path": path},
+        "loss": {"loss_function": "sigmoid"},
+        "feature": {
+            "feature_hash": {
+                "need_feature_hash": True, "bucket_size": buckets,
+                "seed": hseed, "feature_prefix": prefix,
+            },
+            "transform": {"switch_on": True},
+        },
+    }
+    plain_cfg = {"model": {"data_path": path},
+                 "loss": {"loss_function": "sigmoid"}}
+    raw_rows = [
+        {nm: float(rng.randn()) for nm in raw_names if rng.rand() > 0.3}
+        for _ in range(rows_n)
+    ]
+    # what a client doing the pipeline itself would have to send: hashed
+    # names, stats replayed — prep_row's output IS that contract (hash
+    # collisions are already signed-summed, so names are unique)
+    raw_pred = create_predictor("linear", raw_cfg)
+    assembled_rows = [dict(raw_pred.pipeline.prep_row(r)) for r in raw_rows]
+
+    out = {"threads": 16, "raw_features": n_raw, "hash_buckets": buckets}
+    with compile_credit():
+        s_raw = CompiledScorer(raw_pred, ladder=(256,))
+        s_pre = CompiledScorer(
+            create_predictor("linear", plain_cfg), ladder=(256,)
+        )
+        out["assembled_bit_identical"] = bool(np.array_equal(
+            s_raw.score_batch(raw_rows[:256]),
+            s_pre.score_batch(assembled_rows[:256]),
+        ))
+    for label, cfg, arm_rows in (
+        ("raw", raw_cfg, raw_rows),
+        ("assembled", plain_cfg, assembled_rows),
+    ):
+        reg = ModelRegistry(watch_interval_s=0)
+        with compile_credit():
+            reg.load("default", "linear", cfg)
+        app = ServeApp(reg, BatchPolicy(max_batch=512, max_wait_ms=1.0,
+                                        max_queue=1 << 15))
+        try:
+            _drive_app_threads(app, arm_rows, min(seconds, 1.0))  # warm
+            c0 = obs.REGISTRY.counters.get(
+                "compile.traces.backend_compile", 0.0)
+            qps = _drive_app_threads(app, arm_rows, seconds)
+            retraces = obs.REGISTRY.counters.get(
+                "compile.traces.backend_compile", 0.0) - c0
+        finally:
+            for b in app._batchers.values():
+                b.close(drain=True)
+            reg.close()
+        out[f"{label}_req_per_sec"] = round(qps, 1)
+        out[f"{label}_us_per_row"] = (
+            round(1e6 / qps, 2) if qps > 0 else None
+        )
+        out[f"{label}_retraces"] = int(retraces)
+        log.info("transform overhead arm %-10s %8.0f req/s retraces=%d",
+                 label, qps, int(retraces))
+    a = out.get("assembled_req_per_sec") or 0.0
+    r = out.get("raw_req_per_sec") or 0.0
+    if a > 0 and r > 0:
+        out["raw_over_assembled"] = round(r / a, 4)
+        out["transform_us_per_row"] = round(1e6 / r - 1e6 / a, 2)
+    log.info("transform overhead: %s", out)
     return out
 
 
@@ -1412,6 +1521,10 @@ def main() -> int:
             tmp_dir, pred, len(pred.model.trees), rows, args.seconds, log
         )
 
+        transform_overhead = measure_transform_overhead(
+            tmp_dir, min(args.requests, 1024), args.seconds, log
+        )
+
         best = max(
             (r for r in rungs if r["rung"] != "default"),
             key=lambda r: r["req_per_sec"],
@@ -1450,8 +1563,14 @@ def main() -> int:
             "precision_bands": bands,
             "tracing_overhead": tracing,
             "quality_overhead": quality_overhead,
+            "transform_overhead": transform_overhead,
             "data_source": source,
             "trees": len(pred.model.trees),
+            # throughput is only comparable across runs on the same
+            # hardware — check_bench_regress pairs same-core-count
+            # artifacts only (the fleet gate's same-replica-count rule,
+            # applied to the host)
+            "cpu_count": os.cpu_count(),
             "obs": {
                 "counters": {k: round(v, 3)
                              for k, v in sorted(snap["counters"].items())
@@ -1525,6 +1644,19 @@ def main() -> int:
                 f"quality-sampler overhead: {q_sam:.0f} req/s < "
                 f"{q_off:.0f} * (1 - {trace_tol}) at the default "
                 f"YTK_QUALITY_SAMPLE (env BENCH_REGRESS_TOL)"
+            )
+        # transform pipeline (ISSUE 19): the raw-dict wire contract must
+        # score bit-identically to pre-assembled vectors and never leak
+        # steady-state compiles
+        if not transform_overhead.get("assembled_bit_identical", True):
+            fails.append(
+                "raw-dict transform path not bit-identical to "
+                "pre-assembled vectors"
+            )
+        if transform_overhead.get("raw_retraces"):
+            fails.append(
+                f"{transform_overhead['raw_retraces']} steady-state "
+                "retrace(s) on the raw-dict transform path"
             )
         if fleet_rec is not None and fleet_rec.get("retraces_fleet"):
             fails.append(

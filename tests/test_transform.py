@@ -1,0 +1,579 @@
+"""The one transform path (ytklearn_tpu/transform/): bit-equality pins.
+
+The pipeline's contract is not "close to" the reference scalar walk — it
+IS the scalar walk, vectorized. Every test here compares `==` / exact
+array equality against a local reimplementation of the legacy per-scalar
+code (bias drop -> hash_features -> TransformNode.transform per name),
+so any drift in float association, collision order, or the nodeless-zero
+semantic is a hard failure, not a tolerance miss.
+
+The second half trains a REAL linear model from raw text with hashing and
+transforms on (no /root/reference needed), then pins the ISSUE acceptance
+end to end: the sidecar digest discipline at dump/load, steady-state
+zero-retrace raw-dict scoring, a transfer-clean hot path, and a 2-replica
+CLI fleet scoring raw named-feature dicts over HTTP bit-equal to the
+offline predictor.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from ytklearn_tpu.io.feature_hash import FeatureHash
+from ytklearn_tpu.io.fs import LocalFileSystem
+from ytklearn_tpu.io.reader import TransformNode
+from ytklearn_tpu.transform.pipeline import (
+    TransformPipeline,
+    TransformTable,
+    apply_nodes,
+)
+from ytklearn_tpu.transform.sidecar import (
+    DIGEST_PREFIX,
+    model_parts_digest,
+    model_text_digest,
+    read_sidecar,
+    stamp_sidecar_digest,
+    verify_sidecar_digest,
+)
+
+# ---------------------------------------------------------------------------
+# the legacy scalar walk, reimplemented locally as the bit-equality oracle
+# ---------------------------------------------------------------------------
+
+
+def _legacy_transform(nodes, name, val):
+    """reference ContinuousOnlinePredictor.transform:135-143 — transform
+    on: a present feature without a stat node maps to 0.0."""
+    node = nodes.get(name)
+    return node.transform(val) if node is not None else 0.0
+
+
+def _legacy_prep(features, bias_name, feature_hash, nodes, transform_on):
+    """The old per-scalar ContinuousPredictor._prep, verbatim."""
+    items = [(n, v) for n, v in features.items() if n != bias_name]
+    if feature_hash is not None:
+        items = feature_hash.hash_features(items)
+    if not transform_on:
+        return items
+    return [(n, _legacy_transform(nodes, n, v)) for n, v in items]
+
+
+def _legacy_featurize(rows, vocab, dim, bias_col, fill, bias_name,
+                      feature_hash, nodes, transform_on):
+    """The old serve featurize: per-row prep + per-cell scatter."""
+    X = np.full((len(rows), dim), fill, np.float64)
+    for i, row in enumerate(rows):
+        for n, v in _legacy_prep(row, bias_name, feature_hash, nodes,
+                                 transform_on):
+            j = vocab.get(n)
+            if j is not None:
+                X[i, j] = v
+    if bias_col is not None:
+        X[:, bias_col] = 1.0
+    return X
+
+
+def _rand_node(rng):
+    """Random TransformNode hitting both modes AND both degenerate guards
+    (stdvar < 1e-6 identity, |max-min| < 1e-6 constant-1.0)."""
+    mode = "standardization" if rng.rand() < 0.5 else "scale_range"
+    stdvar = rng.rand() * 1e-7 if rng.rand() < 0.2 else 0.1 + rng.rand() * 3
+    if rng.rand() < 0.2:
+        mn = float(rng.randn())
+        mx = mn + rng.rand() * 9e-7
+    else:
+        mn = float(-1 - rng.rand() * 3)
+        mx = mn + 0.5 + rng.rand() * 6
+    return TransformNode(
+        mode=mode,
+        mean=float(rng.randn() * 2),
+        stdvar=float(stdvar),
+        max=float(mx),
+        min=float(mn),
+        range_max=float(1.0 + rng.rand()),
+        range_min=float(-1.0 - rng.rand()),
+    )
+
+
+def _rand_rows(rng, names, n, p_missing=0.4, unknown=True):
+    rows = []
+    for _ in range(n):
+        fmap = {nm: float(rng.randn() * 3) for nm in names
+                if rng.rand() > p_missing}
+        if unknown and rng.rand() < 0.3:
+            fmap[f"never_seen_{rng.randint(100)}"] = float(rng.randn())
+        rows.append(fmap)
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# apply_nodes: the vectorized kernel vs TransformNode.transform, per layout
+# ---------------------------------------------------------------------------
+
+
+def test_apply_nodes_matches_scalar_transform_all_layouts():
+    rng = np.random.RandomState(0)
+    names = [f"n{i}" for i in range(40)]
+    nodes = {nm: _rand_node(rng) for nm in names}
+    vals = rng.randn(400) * 5
+
+    # from_named: row per node + row-0 sentinel (the predictors' layout)
+    table, index = TransformTable.from_named(nodes)
+    gi = np.asarray([index[names[i % len(names)]] for i in range(400)])
+    got = apply_nodes(table, gi, vals.copy())
+    want = np.asarray([nodes[names[i % len(names)]].transform(vals[i])
+                       for i in range(400)])
+    assert np.array_equal(got, want)  # exact, not approx
+
+    # from_indexed: row per global feature index with gaps (ingest layout)
+    inodes = {3 * i + 1: nodes[nm] for i, nm in enumerate(names)}
+    itable = TransformTable.from_indexed(inodes, 3 * len(names) + 2)
+    gi = rng.randint(0, 3 * len(names) + 2, 500)
+    vals = rng.randn(500) * 5
+    got = apply_nodes(itable, gi, vals.copy())
+    want = np.asarray([
+        inodes[g].transform(v) if g in inodes else v
+        for g, v in zip(gi, vals)
+    ])
+    assert np.array_equal(got, want)  # node-less keep raw (ingest semantic)
+
+    # from_vocab: row per scoring column; names outside the vocab ignored
+    vocab = {nm: i for i, nm in enumerate(names[:25])}
+    vtable = TransformTable.from_vocab(nodes, vocab, 25)
+    gi = rng.randint(0, 25, 300)
+    vals = rng.randn(300) * 5
+    got = apply_nodes(vtable, gi, vals.copy(), nodeless_zero=True)
+    want = np.asarray([nodes[names[g]].transform(v)
+                       for g, v in zip(gi, vals)])
+    assert np.array_equal(got, want)
+
+
+def test_apply_nodes_nodeless_semantic_split():
+    """The one flag separating ingest from predict/serve: node-less
+    values keep raw at ingest, map to 0.0 at predict/serve."""
+    rng = np.random.RandomState(1)
+    table, index = TransformTable.from_named({"a": _rand_node(rng)})
+    gi = np.asarray([0, index["a"], 0])  # rows 1 and 3 have no node
+    vals = np.asarray([2.5, 1.0, -7.25])
+    ingest = apply_nodes(table, gi, vals.copy(), nodeless_zero=False)
+    serve = apply_nodes(table, gi, vals.copy(), nodeless_zero=True)
+    assert ingest[0] == 2.5 and ingest[2] == -7.25
+    assert serve[0] == 0.0 and serve[2] == 0.0
+    assert ingest[1] == serve[1] != 0.0
+
+
+# ---------------------------------------------------------------------------
+# prep_row / transform_scalar vs the legacy scalar walk
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("hashing", [False, True])
+@pytest.mark.parametrize("transform_on", [False, True])
+def test_prep_row_matches_legacy_walk(hashing, transform_on):
+    """64 raw names through FeatureHash(16, ...) force heavy collisions;
+    the batched prep must reproduce the dict-accumulation float order and
+    the per-name replay bit-for-bit, including item order."""
+    rng = np.random.RandomState(2)
+    names = [f"raw{i}" for i in range(64)]
+    fh = FeatureHash(16, 3, "h") if hashing else None
+    node_names = ([fh.hash_name(nm)[0] for nm in names] if hashing
+                  else list(names))
+    # nodes on every other (hashed) name: the nodeless-zero branch is live
+    nodes = {nm: _rand_node(rng)
+             for nm in list(dict.fromkeys(node_names))[::2]}
+    pipe = TransformPipeline(bias_name="_bias_", feature_hash=fh,
+                             nodes=nodes, transform_on=transform_on)
+    for row in _rand_rows(rng, names, 30):
+        row["_bias_"] = 1.0  # must be dropped before hashing
+        got = pipe.prep_row(row)
+        want = _legacy_prep(row, "_bias_", fh, nodes, transform_on)
+        assert [n for n, _ in got] == [n for n, _ in want]
+        assert [v for _, v in got] == [v for _, v in want]  # exact ==
+
+
+def test_prep_row_tolerates_bad_value_only_on_nodeless_feature():
+    rng = np.random.RandomState(3)
+    nodes = {"a": _rand_node(rng)}
+    pipe = TransformPipeline(nodes=nodes, transform_on=True)
+    # node-less feature with a non-numeric value: legacy never converted
+    # it (0.0 without touching the value) — must not raise
+    out = dict(pipe.prep_row({"a": 1.5, "junk": "not-a-number"}))
+    assert out["junk"] == 0.0
+    assert out["a"] == nodes["a"].transform(1.5)
+    # a NODED feature's bad value still raises, like node.transform did
+    with pytest.raises((ValueError, TypeError)):
+        pipe.prep_row({"a": "oops"})
+
+
+def test_transform_scalar_matches_node_and_legacy_contract():
+    rng = np.random.RandomState(4)
+    nodes = {f"n{i}": _rand_node(rng) for i in range(20)}
+    pipe = TransformPipeline(nodes=nodes, transform_on=True)
+    for nm, node in nodes.items():
+        for v in rng.randn(5) * 4:
+            assert pipe.transform_scalar(nm, float(v)) == node.transform(v)
+    assert pipe.transform_scalar("unknown", 3.25) == 0.0  # nodeless -> 0
+    off = TransformPipeline(nodes=nodes, transform_on=False)
+    assert off.transform_scalar("n0", 3.25) == 3.25  # switch off: passthrough
+
+
+# ---------------------------------------------------------------------------
+# featurize: the batched serve matrix vs legacy scatter-from-prep
+# ---------------------------------------------------------------------------
+
+
+def test_featurize_hashing_collisions_bit_equal_to_legacy():
+    """8 buckets under 64 raw names: nearly every cell is a collision sum.
+    Two buckets are left out of the vocab (unknown-drop), the last column
+    is the bias; every value must match the legacy walk exactly."""
+    rng = np.random.RandomState(5)
+    names = [f"raw{i}" for i in range(64)]
+    fh = FeatureHash(8, 5, "h")
+    vocab = {f"h{b}": b for b in range(6)}  # h6/h7 hash-resolve to nothing
+    dim, bias_col = 7, 6
+    nodes = {f"h{b}": _rand_node(rng) for b in range(0, 6, 2)}
+    kw = dict(bias_name="_bias_", feature_hash=fh, nodes=nodes)
+    for transform_on in (False, True):
+        pipe = TransformPipeline(vocab=vocab, dim=dim, bias_col=bias_col,
+                                 fill=0.0, transform_on=transform_on, **kw)
+        rows = _rand_rows(rng, names, 40)
+        rows[0] = {}  # empty request row: fill + bias only
+        rows[1]["_bias_"] = 9.0  # bias name in the request: dropped
+        got = pipe.featurize(rows)
+        want = _legacy_featurize(rows, vocab, dim, bias_col, 0.0, "_bias_",
+                                 fh, nodes, transform_on)
+        assert got.shape == (40, dim)
+        assert np.array_equal(got, want)
+        assert (got[:, bias_col] == 1.0).all()
+
+
+def test_featurize_no_hash_transform_replay_bit_equal_to_legacy():
+    rng = np.random.RandomState(6)
+    names = [f"c{i}" for i in range(24)]
+    vocab = {nm: i for i, nm in enumerate(names[:16])}  # 8 names drop
+    nodes = {nm: _rand_node(rng) for nm in names[:16:3]}
+    pipe = TransformPipeline(vocab=vocab, dim=17, bias_col=16, fill=0.0,
+                             bias_name="_bias_", nodes=nodes,
+                             transform_on=True)
+    rows = _rand_rows(rng, names, 32)
+    got = pipe.featurize(rows)
+    want = _legacy_featurize(rows, vocab, 17, 16, 0.0, "_bias_", None,
+                             nodes, True)
+    assert np.array_equal(got, want)
+    # a bad value on a DROPPED feature is tolerated, on a kept one raises
+    assert np.array_equal(
+        pipe.featurize([{"c0": 1.0, "c20": "junk"}]),
+        pipe.featurize([{"c0": 1.0}]),
+    )
+    with pytest.raises((ValueError, TypeError)):
+        pipe.featurize([{"c0": "junk"}])
+
+
+def test_featurize_identity_mode_gbdt_semantics():
+    """gbdt assembly: raw values, NaN missing-fill (routes the tree walk
+    to the default child), unknown drop, no hashing, no replay."""
+    vocab = {f"c{i}": i for i in range(4)}
+    pipe = TransformPipeline.for_identity(vocab, 4, fill=float("nan"))
+    X = pipe.featurize([{"c1": 2.5, "zzz": 9.0}, {"c0": -1.0, "c3": 0.25}])
+    assert X.shape == (2, 4)
+    assert X[0, 1] == 2.5 and X[1, 0] == -1.0 and X[1, 3] == 0.25
+    assert np.isnan(X[0, 0]) and np.isnan(X[0, 2]) and np.isnan(X[0, 3])
+    assert np.isnan(X[1, 1]) and np.isnan(X[1, 2])  # 9.0 dropped, not placed
+    # bad value on a dropped feature tolerated; on a kept feature raises
+    assert np.isnan(pipe.featurize([{"bad": "junk"}])).all()
+    with pytest.raises((ValueError, TypeError)):
+        pipe.featurize([{"c0": "junk"}])
+
+
+# ---------------------------------------------------------------------------
+# sidecar digest discipline (unit level)
+# ---------------------------------------------------------------------------
+
+
+def _write_sidecar(path, nodes):
+    with open(path, "w") as f:
+        for nm, node in nodes.items():
+            f.write(f"{nm}###{node}\n")
+
+
+def test_sidecar_stamp_read_roundtrip(tmp_path):
+    rng = np.random.RandomState(7)
+    fs = LocalFileSystem()
+    nodes = {f"n{i}": _rand_node(rng) for i in range(5)}
+    side = str(tmp_path / "m_feature_transform_stat")
+    _write_sidecar(side, nodes)
+    got, digest = read_sidecar(fs, side)
+    assert digest is None  # ingest-time sidecar: digestless
+    assert set(got) == set(nodes)
+    d1 = model_text_digest("model text v1")
+    stamp_sidecar_digest(fs, side, d1)
+    got, digest = read_sidecar(fs, side)
+    assert digest == d1 and set(got) == set(nodes)
+    for nm in nodes:  # data lines survive the rewrite byte-for-byte
+        assert str(got[nm]) == str(nodes[nm])
+    # re-stamp replaces the header instead of stacking a second one
+    d2 = model_text_digest("model text v2")
+    stamp_sidecar_digest(fs, side, d2)
+    lines = open(side).read().splitlines()
+    assert lines[0] == DIGEST_PREFIX + d2
+    assert sum(ln.startswith("#") for ln in lines) == 1
+    assert read_sidecar(fs, side)[1] == d2
+
+
+def test_sidecar_verify_mismatch_raises(tmp_path):
+    fs = LocalFileSystem()
+    model = str(tmp_path / "model")
+    with open(model, "w") as f:
+        f.write("c0,1.0\n")
+    good = model_parts_digest(fs, model)
+    assert good == model_text_digest("c0,1.0\n")
+    verify_sidecar_digest(fs, model, good)  # matching digest: fine
+    verify_sidecar_digest(fs, model, None)  # legacy digestless: fine
+    # digest stamped before the very first dump (no model yet): fine
+    verify_sidecar_digest(fs, str(tmp_path / "missing"), good)
+    with pytest.raises(ValueError, match="digest mismatch"):
+        verify_sidecar_digest(fs, model, model_text_digest("other text"))
+
+
+# ---------------------------------------------------------------------------
+# the real thing: train raw text -> dump -> digest -> serve raw dicts
+# ---------------------------------------------------------------------------
+
+RAW_FEATS = [f"f{i}" for i in range(8)]
+
+
+def _train_cfg(tmp):
+    """Linear + sigmoid over hashed, standardized features — everything
+    the raw-dict serve path has to replay."""
+    return {
+        "data": {"train": {"data_path": str(tmp / "train.data")}},
+        "model": {"data_path": str(tmp / "lr.model")},
+        "loss": {"loss_function": "sigmoid"},
+        "feature": {
+            "feature_hash": {
+                "need_feature_hash": True,
+                "bucket_size": 64,
+                "seed": 7,
+                "feature_prefix": "fh",
+            },
+            "transform": {"switch_on": True},
+        },
+        "optimization": {
+            "line_search": {"lbfgs": {"convergence": {"max_iter": 5}}}
+        },
+    }
+
+
+def _write_train_data(path, rng, n=256):
+    """`weight###label###name:val,...` rows with per-feature offsets and
+    scales, so standardization stats are non-trivial."""
+    w = rng.randn(len(RAW_FEATS))
+    with open(path, "w") as f:
+        for _ in range(n):
+            feats = {
+                nm: rng.randn() * (1.0 + i) + 2.0 * i
+                for i, nm in enumerate(RAW_FEATS)
+                if rng.rand() > 0.2
+            }
+            z = sum(w[int(nm[1:])] * v for nm, v in feats.items())
+            label = 1 if z + rng.randn() > 0 else 0
+            pairs = ",".join(f"{nm}:{v:.6f}" for nm, v in feats.items())
+            f.write(f"1###{label}###{pairs}\n")
+
+
+@pytest.fixture(scope="module")
+def trained_model(tmp_path_factory):
+    """One real training run shared by the digest / retrace / hotpath /
+    fleet tests below (module-scoped: jit warmup happens here, OUTSIDE
+    the function-scoped sanitize guard — conftest discipline)."""
+    from ytklearn_tpu.config.params import CommonParams
+    from ytklearn_tpu.train import HoagTrainer
+
+    tmp = tmp_path_factory.mktemp("transform_e2e")
+    cfg = _train_cfg(tmp)
+    _write_train_data(cfg["data"]["train"]["data_path"],
+                      np.random.RandomState(11))
+    p = CommonParams.from_config(cfg)
+    res = HoagTrainer(p, "linear").train()
+    assert res.avg_loss < 0.6  # learned something beyond chance
+    return cfg, p
+
+
+def _predictor(cfg):
+    from ytklearn_tpu.predict import create_predictor
+
+    return create_predictor("linear", cfg)
+
+
+def test_dump_stamps_sidecar_digest_matching_model(trained_model):
+    cfg, p = trained_model
+    fs = LocalFileSystem()
+    side = p.model.data_path + "_feature_transform_stat"
+    nodes, digest = read_sidecar(fs, side)
+    assert nodes, "training with transform.switch_on wrote no stats"
+    assert all(nm.startswith("fh") for nm in nodes)  # hashed-name keyed
+    assert digest is not None
+    assert digest == model_parts_digest(fs, p.model.data_path)
+    with open(side) as f:
+        assert f.readline().startswith(DIGEST_PREFIX)  # header line first
+
+
+def test_tampered_model_refuses_to_load(trained_model, tmp_path):
+    """The crash-between-writes drill: model text that no longer matches
+    the sidecar's stamp must fail the load, not serve skewed stats."""
+    import shutil
+
+    cfg, p = trained_model
+    root = tmp_path / "copy"
+    shutil.copytree(p.model.data_path, root / "lr.model")
+    shutil.copy(p.model.data_path + "_feature_transform_stat",
+                str(root / "lr.model") + "_feature_transform_stat")
+    cfg2 = json.loads(json.dumps(cfg))
+    cfg2["model"]["data_path"] = str(root / "lr.model")
+    _predictor(cfg2)  # faithful copy loads fine
+    with open(root / "lr.model" / "model-00000", "a") as f:
+        f.write("fh0,0.125\n")
+    with pytest.raises(ValueError, match="digest mismatch"):
+        _predictor(cfg2)
+
+
+def test_legacy_digestless_sidecar_still_loads(trained_model, tmp_path):
+    import shutil
+
+    cfg, p = trained_model
+    root = tmp_path / "legacy"
+    shutil.copytree(p.model.data_path, root / "lr.model")
+    side = str(root / "lr.model") + "_feature_transform_stat"
+    with open(p.model.data_path + "_feature_transform_stat") as f:
+        body = [ln for ln in f if not ln.startswith("#")]
+    with open(side, "w") as f:
+        f.writelines(body)  # an old trainer's sidecar: no header
+    cfg2 = json.loads(json.dumps(cfg))
+    cfg2["model"]["data_path"] = str(root / "lr.model")
+    pred, ref = _predictor(cfg2), _predictor(cfg)
+    rows = _rand_rows(np.random.RandomState(12), RAW_FEATS, 8, unknown=False)
+    assert list(pred.batch_scores(rows)) == list(ref.batch_scores(rows))
+
+
+def test_raw_dict_path_zero_steady_state_retraces(trained_model):
+    """ISSUE acceptance: raw named-feature dicts through the full
+    hash+transform pipeline must not retrace once the ladder is warm."""
+    from ytklearn_tpu.obs import configure, core, reset
+    from ytklearn_tpu.obs.health import install_trace_counters
+    from ytklearn_tpu.serve import CompiledScorer
+
+    cfg, _ = trained_model
+    pred = _predictor(cfg)
+    configure(enabled=True)
+    install_trace_counters()
+    try:
+        scorer = CompiledScorer(pred, ladder=(1, 4, 16))
+        baseline = core.REGISTRY.counters.get(
+            "compile.traces.backend_compile", 0.0)
+        rng = np.random.RandomState(13)
+        for n in (1, 3, 4, 7, 16, 2, 16, 1, 9):
+            scorer.score_batch(_rand_rows(rng, RAW_FEATS, n))
+        after = core.REGISTRY.counters.get(
+            "compile.traces.backend_compile", 0.0)
+        assert after == baseline, "steady-state retrace on the raw-dict path"
+        assert core.REGISTRY.counters.get("health.retrace", 0.0) == 0.0
+    finally:
+        configure(enabled=False)
+        reset()
+
+
+@pytest.fixture(scope="module")
+def warm_raw_scorer(trained_model):
+    """Build + warm outside the sanitize guard (load-time compiles and
+    transfers are legal; the steady state below must be clean)."""
+    from ytklearn_tpu.serve import CompiledScorer
+
+    cfg, _ = trained_model
+    pred = _predictor(cfg)
+    scorer = CompiledScorer(pred, ladder=(1, 4, 16))
+    rows = _rand_rows(np.random.RandomState(14), RAW_FEATS, 11)
+    want = scorer.score_batch(rows)
+    return scorer, rows, want
+
+
+@pytest.mark.hotpath("serve")
+def test_raw_dict_scoring_hotpath_is_transfer_clean(warm_raw_scorer):
+    """Steady-state raw-dict scoring (hash + transform replay + ladder)
+    under jax.transfer_guard('disallow') + debug_nans: the batched
+    pipeline stays host-side numpy and the device hop stays explicit."""
+    scorer, rows, want = warm_raw_scorer
+    got = scorer.score_batch(rows)
+    assert np.array_equal(got, want)  # deterministic replay, bit-identical
+    assert np.isfinite(got).all()
+
+
+def test_cli_fleet_serves_raw_dicts_bit_equal_to_offline(trained_model):
+    """The tentpole acceptance, end to end: train-from-raw-libsvm (module
+    fixture) -> 2-replica CLI fleet -> POST raw named-feature dicts ->
+    scores `==` (NOT approx) the offline predictor.
+
+    Two comparisons pin it: single-feature rows against the offline host
+    walk (`batch_scores`) — one nonzero product per row, so the jit dot
+    and the host loop are the same float sum; multi-feature rows against
+    an in-process CompiledScorer on the same ladder — the same compiled
+    kernel the fleet replicas run."""
+    import urllib.error
+    import urllib.request
+
+    from ytklearn_tpu.serve import CompiledScorer
+
+    cfg, _ = trained_model
+    pred = _predictor(cfg)
+    conf = os.path.join(os.path.dirname(cfg["model"]["data_path"]),
+                        "serve.conf")
+    with open(conf, "w") as f:
+        json.dump(cfg, f)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ytklearn_tpu.cli", "serve", conf, "linear",
+         "--port", "0", "--host", "127.0.0.1", "--replicas", "2",
+         "--ladder", "1,8", "--watch-interval", "0"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=env, text=True,
+    )
+
+    def _post(port, rows):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/predict",
+            data=json.dumps({"rows": rows}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=30.0) as resp:
+            assert resp.status == 200
+            return json.loads(resp.read())
+
+    rng = np.random.RandomState(15)
+    single = [{RAW_FEATS[rng.randint(8)]: float(rng.randn() * 3)}
+              for _ in range(6)]
+    multi = _rand_rows(rng, RAW_FEATS, 6)
+    try:
+        info = json.loads(proc.stdout.readline())
+        assert info["fleet"] is True and info["replicas"] == 2
+        port = info["port"]
+
+        # raw dicts over the wire == the offline predict host walk, bit
+        # for bit (JSON round-trips float64 exactly, so `==` is honest)
+        out = _post(port, single)
+        assert out["scores"] == list(pred.batch_scores(single))
+        assert out["version"] == 1 and out["replica"] in (0, 1)
+
+        # multi-feature rows: == the same compiled ladder kernel
+        scorer = CompiledScorer(pred, ladder=(1, 8))
+        out = _post(port, multi)
+        assert out["scores"] == [float(s) for s in scorer.score_batch(multi)]
+
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=60.0) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10.0)

@@ -370,6 +370,13 @@ _knob("YTK_SERVE_SCALE_DOWN_COOLDOWN_S", "float", 30.0,
       "seconds after ANY scale decision before a scale-down may fire "
       "(capacity a spike just paid for is never reaped immediately)")
 
+# -- transform pipeline -----------------------------------------------------
+_knob("YTK_TRANSFORM_CACHE", "int", 1_000_000,
+      "bound on the serve-time feature-hash resolution cache (raw name "
+      "-> scoring column + murmur sign, per loaded model); at the bound "
+      "new names compute uncached, so a fresh-name flood costs cpu, "
+      "never memory")
+
 # -- bench ------------------------------------------------------------------
 _knob("YTK_CHIP", "str", "v5e",
       "chip key for bench roofline peaks (MXU/HBM utilization fields)",

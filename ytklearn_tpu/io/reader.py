@@ -522,14 +522,12 @@ class DataIngest:
         nodes: Dict[int, TransformNode] = {}
         if not self.fs.exists(path):
             return nodes
-        with self.fs.open(path) as f:
-            for line in f:
-                line = line.strip()
-                if not line:
-                    continue
-                name, _, payload = line.partition("###")
-                if name in fmap:
-                    nodes[fmap[name]] = TransformNode.from_string(payload)
+        from ..transform.sidecar import read_sidecar
+
+        named, _digest = read_sidecar(self.fs, path)  # '#' header skipped
+        for name, node in named.items():
+            if name in fmap:
+                nodes[fmap[name]] = node
         return nodes
 
     # -- materialization -------------------------------------------------
@@ -562,18 +560,38 @@ class DataIngest:
                     fi = fm.get(name.split(fdelim)[0], -1)
                     if fi < 0:
                         continue  # unknown field — dropped
-                node = nodes.get(gi)
-                entries.append((gi, node.transform(v) if node else v, fi))
+                entries.append((gi, v, fi))
             mapped.append(entries)
             width = max(width, len(entries))
         width = max(width, 1)
+        tv = None
+        if nodes:
+            # one vectorized replay over every kept entry — the same
+            # apply_nodes kernel ingest's columnar path, the offline
+            # predictors, and the serving pipeline share (transform/).
+            # The bias entry has no node (nodes_from_stats excludes the
+            # bias name), so replaying it is the identity.
+            from ..transform.pipeline import TransformTable, apply_nodes
+
+            flat_gi = np.fromiter(
+                (e[0] for es in mapped for e in es),
+                np.int64,
+                sum(len(es) for es in mapped),
+            )
+            flat_v = np.fromiter(
+                (e[1] for es in mapped for e in es), np.float64, len(flat_gi)
+            )
+            table = TransformTable.from_indexed(nodes, len(fmap))
+            tv = apply_nodes(table, flat_gi, flat_v) if len(flat_gi) else flat_v
         idx = np.zeros((n, width), np.int32)
         val = np.zeros((n, width), np.float32)
         field = np.zeros((n, width), np.int32) if fm is not None else None
+        k = 0
         for i, entries in enumerate(mapped):
             for j, (gi, v, fi) in enumerate(entries):
                 idx[i, j] = gi
-                val[i, j] = v
+                val[i, j] = tv[k] if tv is not None else v
+                k += 1
                 if field is not None:
                     field[i, j] = fi
         y = np.asarray(
@@ -807,33 +825,14 @@ class DataIngest:
         gi = gi[keep]
         val = cols.occ_val[keep].astype(np.float64)
 
-        if nodes:
-            dim = len(fmap)
-            has = np.zeros(dim, bool)
-            is_std = np.zeros(dim, bool)
-            mean = np.zeros(dim)
-            std = np.zeros(dim)
-            mn = np.zeros(dim)
-            mx = np.zeros(dim)
-            rmin = np.zeros(dim)
-            rmax = np.zeros(dim)
-            for g, node in nodes.items():
-                has[g] = True
-                is_std[g] = node.mode == "standardization"
-                mean[g], std[g] = node.mean, node.stdvar
-                mn[g], mx[g] = node.min, node.max
-                rmin[g], rmax[g] = node.range_min, node.range_max
-            h = has[gi]
-            stdv = std[gi]
-            std_ok = is_std[gi] & (stdv >= 1e-6)
-            val = np.where(h & std_ok, (val - mean[gi]) / np.where(stdv == 0, 1, stdv), val)
-            span = mx[gi] - mn[gi]
-            small = np.abs(span) < 1e-6
-            scaled = np.where(
-                small, 1.0,
-                rmin[gi] + (rmax[gi] - rmin[gi]) * (val - mn[gi]) / np.where(small, 1, span),
-            )
-            val = np.where(h & ~is_std[gi], scaled, val)
+        if nodes and len(gi):
+            # the shared vectorized TransformNode replay (transform/) —
+            # the identical kernel the serving pipeline executes, so the
+            # trained values and the served values cannot drift
+            from ..transform.pipeline import TransformTable, apply_nodes
+
+            table = TransformTable.from_indexed(nodes, len(fmap))
+            val = apply_nodes(table, gi, val)
 
         cnt = np.bincount(occ_row, minlength=n) if n else np.zeros(0, np.int64)
         delta = 1 if need_bias else 0

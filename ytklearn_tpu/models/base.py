@@ -141,21 +141,46 @@ class ConvexModel:
     ) -> None:
         """Per-feature text lines; subclasses supply model_line(). Both
         files land via atomic write-then-replace so the serving registry's
-        fingerprint watcher never parses a half-written dump."""
+        fingerprint watcher never parses a half-written dump. The model
+        text is built first so the transform-stat sidecar can be stamped
+        with its digest BEFORE the model lands (transform/sidecar.py —
+        a crash between the writes is detected at serve load)."""
         p = self.params.model
         start, end = self._feature_slice(rank, n_parts)
         model_path, dict_path = self._part_paths(rank)
+        model_lines: List[str] = []
+        dict_lines: List[str] = []
+        for name, i in feature_map.items():
+            if not (start <= i < end):
+                continue
+            is_bias = name.lower() == p.bias_feature_name.lower()
+            line = self.model_line(name, i, w, precision, is_bias)
+            if line is None:
+                continue
+            model_lines.append(line + "\n")
+            if not is_bias:
+                dict_lines.append(name + "\n")
+        self._stamp_transform_sidecar(fs, "".join(model_lines), rank, n_parts)
         with fs.atomic_open(model_path) as mf, fs.atomic_open(dict_path) as df:
-            for name, i in feature_map.items():
-                if not (start <= i < end):
-                    continue
-                is_bias = name.lower() == p.bias_feature_name.lower()
-                line = self.model_line(name, i, w, precision, is_bias)
-                if line is None:
-                    continue
-                mf.write(line + "\n")
-                if not is_bias:
-                    df.write(name + "\n")
+            mf.writelines(model_lines)
+            df.writelines(dict_lines)
+
+    def _stamp_transform_sidecar(
+        self, fs: FileSystem, model_text: str, rank: int, n_parts: int
+    ) -> None:
+        """Embed a digest of the model text about to land in the
+        transform-stat sidecar (single-part rank0 dumps only — the
+        production convex path; multi-part digests would need text from
+        every rank, so those sidecars stay digestless and load like
+        legacy ones)."""
+        if rank != 0 or n_parts != 1:
+            return
+        if not self.params.feature.transform.switch_on:
+            return
+        from ..transform.sidecar import model_text_digest, stamp_sidecar_digest
+
+        side = self.params.model.data_path + "_feature_transform_stat"
+        stamp_sidecar_digest(fs, side, model_text_digest(model_text))
 
     def model_line(
         self, name: str, i: int, w: np.ndarray, precision, is_bias: bool

@@ -129,18 +129,24 @@ class LinearModel(ConvexModel):
         d = p.delim
         model_path = f"{p.data_path}/model-{rank:05d}"
         dict_path = f"{p.data_path}_dict/dict-{rank:05d}"
+        model_lines = []
+        dict_lines = []
+        for name, i in feature_map.items():
+            if not (start <= i < end):
+                continue
+            if name.lower() == p.bias_feature_name.lower():
+                model_lines.append(f"{name}{d}{w[i]:f}{d}null\n")
+                continue
+            if abs(w[i]) <= 0.0:
+                continue
+            prec = precision[i] if precision is not None else 0.0
+            model_lines.append(f"{name}{d}{w[i]:f}{d}{prec:f}\n")
+            dict_lines.append(f"{name}\n")
+        # sidecar digest stamp BEFORE the model text lands (models/base.py)
+        self._stamp_transform_sidecar(fs, "".join(model_lines), rank, n_parts)
         with fs.atomic_open(model_path) as mf, fs.atomic_open(dict_path) as df:
-            for name, i in feature_map.items():
-                if not (start <= i < end):
-                    continue
-                if name.lower() == p.bias_feature_name.lower():
-                    mf.write(f"{name}{d}{w[i]:f}{d}null\n")
-                    continue
-                if abs(w[i]) <= 0.0:
-                    continue
-                prec = precision[i] if precision is not None else 0.0
-                mf.write(f"{name}{d}{w[i]:f}{d}{prec:f}\n")
-                df.write(f"{name}\n")
+            mf.writelines(model_lines)
+            df.writelines(dict_lines)
 
     def load_model(
         self, fs: FileSystem, feature_map: Dict[str, int]

@@ -20,6 +20,8 @@ from ..io.fs import FileSystem
 from ..io.reader import TransformNode
 from ..losses import create_loss
 from ..models.ffm import load_field_dict
+from ..transform.pipeline import TransformPipeline
+from ..transform.sidecar import read_sidecar, verify_sidecar_digest
 from .base import OnlinePredictor
 
 PRECISION_MIN = 1e-9  # reference: LinearOnlinePredictor.java:38
@@ -44,15 +46,21 @@ class ContinuousPredictor(OnlinePredictor):
         self.transform_nodes: Dict[str, TransformNode] = {}
         if p.feature.transform.switch_on:
             stat_path = p.model.data_path + "_feature_transform_stat"
-            with self.fs.open(stat_path) as f:
-                for line in f:
-                    line = line.strip()
-                    if not line:
-                        continue
-                    name, _, payload = line.partition("###")
-                    self.transform_nodes[name.strip()] = TransformNode.from_string(
-                        payload.strip()
-                    )
+            nodes, digest = read_sidecar(self.fs, stat_path)
+            # the dump stamps the sidecar with a digest of the model text
+            # it was written alongside (transform/sidecar.py); a mismatch
+            # is the crash-between-writes window — refuse to serve skewed
+            # transforms. Legacy digestless sidecars pass untouched.
+            verify_sidecar_digest(self.fs, p.model.data_path, digest)
+            self.transform_nodes = nodes
+        # the one batched transform path (transform/pipeline.py), shared
+        # with ingest and the serving ladder — _prep routes through it
+        self.pipeline = TransformPipeline(
+            bias_name=p.model.bias_feature_name,
+            feature_hash=self.feature_hash,
+            nodes=self.transform_nodes,
+            transform_on=p.feature.transform.switch_on,
+        )
         self._load_model()
 
     # -- shared plumbing --------------------------------------------------
@@ -60,21 +68,13 @@ class ContinuousPredictor(OnlinePredictor):
     def _transform(self, name: str, val: float) -> float:
         """reference: ContinuousOnlinePredictor.transform:135-143 — when
         transform is on, features without a stat node map to 0."""
-        if not self.params.feature.transform.switch_on:
-            return val
-        node = self.transform_nodes.get(name)
-        if node is None:
-            return 0.0
-        return node.transform(val)
+        return self.pipeline.transform_scalar(name, val)
 
     def _prep(self, features: Dict[str, float]) -> List[Tuple[str, float]]:
         """bias removal + optional hashing + transform replay
-        (reference: every predictor's score() prologue)."""
-        bias_name = self.params.model.bias_feature_name
-        items = [(n, v) for n, v in features.items() if n != bias_name]
-        if self.feature_hash is not None:
-            items = self.feature_hash.hash_features(items)
-        return [(n, self._transform(n, v)) for n, v in items]
+        (reference: every predictor's score() prologue), executed by the
+        shared vectorized pipeline."""
+        return self.pipeline.prep_row(features)
 
     def _model_lines(self, path: str):
         """Yield delim-split nonempty lines from every model part file."""

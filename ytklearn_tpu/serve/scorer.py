@@ -23,10 +23,15 @@ Lowering per family (model maps -> dense arrays, request dicts -> rows):
   gbmlr/gbsdt/...   stacked per-tree expert/gate matrices, softmax or
                     heap-sigmoid gating
 
-Host featurization stays the predictor's own `_prep` (hashing + transform
-replay), so a served request sees byte-for-byte the same feature pipeline as
-the offline path. Sample-dependent base predictions (`other`) are an offline
-concept and not supported here.
+Host featurization runs the shared TransformPipeline (transform/) — vector
+assembly against the model vocab, murmur hashing with signed collision
+accumulation, missing fill, and transform-stat replay as ONE numpy batch
+stage per micro-batch (the `serve.transform` trace hop) instead of a
+per-scalar host loop. It is the same implementation the trainers' ingest
+and the offline predictors execute, so a served request sees bit-for-bit
+the same feature pipeline as the offline path by construction.
+Sample-dependent base predictions (`other`) are an offline concept and not
+supported here.
 """
 
 from __future__ import annotations
@@ -53,6 +58,7 @@ from ..predict.continuous import (
     MulticlassLinearPredictor,
 )
 from ..predict.trees import GBDTPredictor, GBSTPredictor
+from ..transform.pipeline import TransformPipeline
 
 log = logging.getLogger(__name__)
 
@@ -172,6 +178,26 @@ class CompiledScorer:
         self._prep_is_identity = False  # gbdt: rows pass through untransformed
         self._lower()
         self.dim = len(self.vocab) + (1 if self._bias_col is not None else 0)
+        # the shared batched featurize path (transform/pipeline.py):
+        # identity assembly for gbdt (raw values, NaN missing-fill), the
+        # full bias-drop -> hash -> assemble -> replay stage for the
+        # _prep families — one implementation with ingest and predict
+        if self._prep_is_identity:
+            self._pipeline = TransformPipeline.for_identity(
+                self.vocab, self.dim, fill=self._fill
+            )
+        else:
+            pp = predictor.params
+            self._pipeline = TransformPipeline(
+                vocab=self.vocab,
+                dim=self.dim,
+                bias_col=self._bias_col,
+                fill=self._fill,
+                bias_name=pp.model.bias_feature_name,
+                feature_hash=predictor.feature_hash,
+                nodes=predictor.transform_nodes,
+                transform_on=pp.feature.transform.switch_on,
+            )
         self._jit = jax.jit(self._kernel)
         if self._exec is None:
             self._exec = self._exec_jit
@@ -230,60 +256,20 @@ class CompiledScorer:
         return info
 
     def featurize(self, rows: Sequence[Dict[str, float]]) -> np.ndarray:
-        """Request dicts -> dense (B, dim) float64 via the predictor's own
-        host pipeline (hash + transform replay; raw values for gbdt)."""
-        X = np.full((len(rows), self.dim), self._fill, np.float64)
-        vocab = self.vocab
-        if self._prep_is_identity:
-            # gbdt rows need no transform replay: drain every dict with
-            # C-speed extend/map instead of a per-item python loop (~2x
-            # on the serve hot path, scripts/serve_bench.py)
-            import itertools
-
-            keys: List[str] = []
-            vals: List[float] = []
-            lens: List[int] = []
-            ke, ve, la = keys.extend, vals.extend, lens.append
-            for fmap in rows:
-                ke(fmap.keys())
-                ve(fmap.values())
-                la(len(fmap))
-            if keys:
-                jj = np.fromiter(
-                    map(vocab.get, keys, itertools.repeat(-1)),
-                    np.int64, len(keys),
-                )
-                ii = np.repeat(np.arange(len(rows)), lens)
-                m = jj >= 0  # unknown features drop, as in the host walk
-                try:
-                    vv = np.asarray(vals, np.float64)
-                except (ValueError, TypeError):
-                    # a non-numeric value on an UNKNOWN (dropped) feature
-                    # must not fail the request — the slow path never
-                    # converted it; a known feature's bad value still
-                    # raises, exactly like the scatter below would
-                    vv = np.asarray(
-                        [float(v) if k else 0.0 for v, k in zip(vals, m)],
-                        np.float64,
-                    )
-                if m.any():
-                    X[ii[m], jj[m]] = vv[m]
-            return X
-        ii: List[int] = []
-        jj: List[int] = []
-        vv: List[float] = []
-        for i, fmap in enumerate(rows):
-            for name, val in self._prep(fmap):
-                j = vocab.get(name)
-                if j is not None:
-                    ii.append(i)
-                    jj.append(j)
-                    vv.append(val)
-        if ii:
-            X[ii, jj] = vv  # one vectorized scatter, not len(ii) writes
-        if self._bias_col is not None:
-            X[:, self._bias_col] = 1.0
-        return X
+        """Request dicts -> dense (B, dim) float64 via the shared batched
+        pipeline (transform/pipeline.py): hash + transform replay for the
+        _prep families, raw values with NaN fill for gbdt. The transform
+        stage gets its own `serve.transform` hop nested inside
+        `serve.assemble` so ytkprof can split assembly cost from the
+        hash/replay cost."""
+        pipe = self._pipeline
+        if pipe.identity:
+            # gbdt identity assembly: no hashing, no stat replay — the
+            # hop would only measure the scatter serve.assemble already
+            # covers
+            return pipe.featurize(rows)
+        with obs_trace.batch_hop("serve.transform", rows=len(rows)):
+            return pipe.featurize(rows)
 
     def score_batch(self, rows: Sequence[Dict[str, float]]) -> np.ndarray:
         """Raw scores, shape (B,) or (B, K) — the batch_scores contract."""

@@ -1,0 +1,24 @@
+"""Compiled serve-time feature pipeline: one transform path for train,
+offline predict, and serve (docs/transform.md)."""
+
+from .pipeline import TransformPipeline, TransformTable, apply_nodes
+from .sidecar import (
+    DIGEST_PREFIX,
+    model_parts_digest,
+    model_text_digest,
+    read_sidecar,
+    stamp_sidecar_digest,
+    verify_sidecar_digest,
+)
+
+__all__ = [
+    "TransformPipeline",
+    "TransformTable",
+    "apply_nodes",
+    "DIGEST_PREFIX",
+    "model_parts_digest",
+    "model_text_digest",
+    "read_sidecar",
+    "stamp_sidecar_digest",
+    "verify_sidecar_digest",
+]

@@ -1,0 +1,353 @@
+"""The one vectorized transform path for train, offline predict, and serve.
+
+Rebuild of the reference front door — the feature-preprocessing layer
+(reference: dataflow feature transform + FeatureHash, PAPER.md §L3/L7) —
+as a single batched implementation shared by every consumer:
+
+* ingest (`io/reader.py::to_dataset` / `_cols_to_dataset`) replays
+  TransformNode normalization over the materialized columns,
+* offline predictors (`predict/continuous.py::_prep`) route each row's
+  bias-drop → murmur-hash → replay through `prep_row`,
+* the serving ladder (`serve/scorer.py::featurize`) assembles raw
+  named-feature dicts straight into the dense `(B, dim)` scoring matrix
+  with `featurize` — vector assembly against the model vocab, signed
+  hash-collision accumulation, missing-value fill, and normalization
+  replay as one numpy batch stage instead of a per-scalar host loop.
+
+Because all three call the same `apply_nodes` kernel, train/serve skew
+is structurally impossible: there is no second implementation to drift.
+
+Semantics pinned bit-for-bit against the scalar reference
+(`TransformNode.transform`, `ContinuousPredictor._transform`) by
+tests/test_transform.py:
+
+* standardization: ``(val - mean) / stdvar`` unless ``stdvar < 1e-6``
+  (identity);
+* scale_range: ``rmin + (rmax - rmin) * ((val - min) / (max - min))``,
+  or ``1.0`` when ``|max - min| < 1e-6``;
+* predict/serve only (``nodeless_zero``): when the transform switch is
+  on, a present feature WITHOUT a stat node maps to 0.0 (reference:
+  ContinuousOnlinePredictor.transform:135-143). Ingest keeps raw values
+  for node-less (e.g. excluded) features — reference DataFlow behavior.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..config import knobs
+from ..io.feature_hash import FeatureHash
+
+__all__ = ["TransformTable", "apply_nodes", "TransformPipeline"]
+
+
+@dataclass
+class TransformTable:
+    """TransformNode fields as dense per-index lookup arrays.
+
+    Row semantics depend on the builder: per-global-feature-index for
+    ingest (`from_indexed`), per-vocab-column for serve (`from_vocab`),
+    or per-node with a row-0 no-node sentinel for the predictors'
+    name-keyed path (`from_named`)."""
+
+    has: np.ndarray  # bool — a stat node exists for this index
+    is_std: np.ndarray  # bool — mode == standardization
+    mean: np.ndarray
+    std: np.ndarray
+    mn: np.ndarray
+    mx: np.ndarray
+    rmin: np.ndarray
+    rmax: np.ndarray
+
+    @classmethod
+    def zeros(cls, dim: int) -> "TransformTable":
+        return cls(
+            has=np.zeros(dim, bool),
+            is_std=np.zeros(dim, bool),
+            mean=np.zeros(dim),
+            std=np.zeros(dim),
+            mn=np.zeros(dim),
+            mx=np.zeros(dim),
+            rmin=np.zeros(dim),
+            rmax=np.zeros(dim),
+        )
+
+    def set_node(self, i: int, node) -> None:
+        self.has[i] = True
+        self.is_std[i] = node.mode == "standardization"
+        self.mean[i], self.std[i] = node.mean, node.stdvar
+        self.mn[i], self.mx[i] = node.min, node.max
+        self.rmin[i], self.rmax[i] = node.range_min, node.range_max
+
+    @classmethod
+    def from_indexed(cls, nodes: Dict[int, object], dim: int) -> "TransformTable":
+        """Ingest layout: one row per global feature index."""
+        t = cls.zeros(dim)
+        for g, node in nodes.items():
+            t.set_node(g, node)
+        return t
+
+    @classmethod
+    def from_named(
+        cls, nodes: Dict[str, object]
+    ) -> Tuple["TransformTable", Dict[str, int]]:
+        """Predictor layout: one row per node plus a row-0 "no node"
+        sentinel; the returned index maps name -> row (missing -> 0)."""
+        t = cls.zeros(len(nodes) + 1)
+        index: Dict[str, int] = {}
+        for i, (name, node) in enumerate(nodes.items(), start=1):
+            index[name] = i
+            t.set_node(i, node)
+        return t, index
+
+    @classmethod
+    def from_vocab(
+        cls, nodes: Dict[str, object], vocab: Dict[str, int], dim: int
+    ) -> "TransformTable":
+        """Serve layout: one row per scoring column (model vocab order);
+        sidecar names absent from the vocab are irrelevant (those
+        features are dropped by assembly before replay)."""
+        t = cls.zeros(max(dim, 1))
+        for name, node in nodes.items():
+            col = vocab.get(name)
+            if col is not None:
+                t.set_node(col, node)
+        return t
+
+
+def apply_nodes(
+    table: TransformTable,
+    gi: np.ndarray,
+    val: np.ndarray,
+    nodeless_zero: bool = False,
+) -> np.ndarray:
+    """Vectorized TransformNode replay — THE transform implementation.
+
+    ``gi`` indexes rows of ``table``; ``val`` is float64. Returns the
+    transformed values (float64). ``nodeless_zero`` selects the
+    predict/serve semantic (no-node features -> 0.0); ingest passes
+    False so excluded features keep their raw values."""
+    h = table.has[gi]
+    stdv = table.std[gi]
+    std_ok = table.is_std[gi] & (stdv >= 1e-6)
+    val = np.where(
+        h & std_ok,
+        (val - table.mean[gi]) / np.where(stdv == 0, 1, stdv),
+        val,
+    )
+    span = table.mx[gi] - table.mn[gi]
+    small = np.abs(span) < 1e-6
+    # a * (b / c) association, matching the scalar TransformNode.transform
+    # exactly (bit-equality pinned by tests/test_transform.py)
+    scaled = np.where(
+        small,
+        1.0,
+        table.rmin[gi]
+        + (table.rmax[gi] - table.rmin[gi])
+        * ((val - table.mn[gi]) / np.where(small, 1, span)),
+    )
+    val = np.where(h & ~table.is_std[gi], scaled, val)
+    if nodeless_zero:
+        val = np.where(h, val, 0.0)
+    return val
+
+
+class TransformPipeline:
+    """Batched raw-features front door for one loaded model.
+
+    Two modes share the class:
+
+    * full (convex/GBST families): bias-name drop, murmur feature
+      hashing with signed collision accumulation, vocab assembly,
+      missing fill, TransformNode replay;
+    * identity (GBDT): raw values scattered against the vocab with the
+      missing fill (NaN routes a row to the split's default child) —
+      no hashing, no replay.
+
+    `featurize` (serve) and `prep_row` (offline predictors) reproduce
+    the legacy per-scalar `_prep` results bit-for-bit; unknown features
+    (no vocab column after hashing) drop exactly like the host walk,
+    and a non-numeric value is tolerated only on a dropped feature — a
+    kept feature's bad value still raises."""
+
+    def __init__(
+        self,
+        *,
+        vocab: Optional[Dict[str, int]] = None,
+        dim: int = 0,
+        bias_col: Optional[int] = None,
+        fill: float = 0.0,
+        bias_name: Optional[str] = None,
+        feature_hash: Optional[FeatureHash] = None,
+        nodes: Optional[Dict[str, object]] = None,
+        transform_on: bool = False,
+        identity: bool = False,
+    ):
+        self.vocab = vocab
+        self.dim = dim
+        self.bias_col = bias_col
+        self.fill = fill
+        self.bias_name = bias_name
+        self.feature_hash = feature_hash
+        self.nodes: Dict[str, object] = dict(nodes or {})
+        self.transform_on = transform_on
+        self.identity = identity
+        # name-keyed replay table for prep_row (row 0 = no-node sentinel)
+        self._name_table, self._name_index = TransformTable.from_named(self.nodes)
+        # column-keyed replay table for featurize (built lazily: the
+        # predictors construct a pipeline before any vocab exists)
+        self._col_table: Optional[TransformTable] = None
+        if vocab is not None and not identity:
+            self._col_table = TransformTable.from_vocab(self.nodes, vocab, dim)
+        # murmur results are pure per-name: cache raw name -> (col, sign)
+        # so steady-state traffic hashes each distinct name once. Bounded
+        # (YTK_TRANSFORM_CACHE); at the bound new names compute uncached,
+        # so a client flooding fresh names costs cpu, never memory.
+        self._hash_cache: Dict[str, Tuple[int, float]] = {}
+        self._hash_cache_cap = max(int(knobs.get_int("YTK_TRANSFORM_CACHE")), 0)
+        self._hash_lock = threading.Lock()
+
+    @classmethod
+    def for_identity(
+        cls, vocab: Dict[str, int], dim: int, fill: float
+    ) -> "TransformPipeline":
+        return cls(vocab=vocab, dim=dim, fill=fill, identity=True)
+
+    # -- offline predictor path ------------------------------------------
+
+    def prep_row(self, features: Dict[str, float]) -> List[Tuple[str, float]]:
+        """bias removal + optional hashing + vectorized transform replay
+        (the `ContinuousPredictor._prep` contract, one row at a time)."""
+        items = [(n, v) for n, v in features.items() if n != self.bias_name]
+        if self.feature_hash is not None:
+            items = self.feature_hash.hash_features(items)
+        if not self.transform_on or not items:
+            return items
+        idx = np.fromiter(
+            (self._name_index.get(n, 0) for n, _ in items),
+            np.int64,
+            len(items),
+        )
+        try:
+            vals = np.fromiter((v for _, v in items), np.float64, len(items))
+        except (ValueError, TypeError):
+            # node-less features map to 0.0 without touching the value
+            # (the scalar path never converted them); a noded feature's
+            # bad value still raises, exactly like node.transform did
+            vals = np.asarray(
+                [float(v) if ix else 0.0 for (_, v), ix in zip(items, idx)],
+                np.float64,
+            )
+        out = apply_nodes(self._name_table, idx, vals, nodeless_zero=True)
+        return [(items[i][0], float(out[i])) for i in range(len(items))]
+
+    def transform_scalar(self, name: str, val: float) -> float:
+        """One-feature replay (the legacy `_transform(name, val)` API),
+        routed through the same vectorized kernel."""
+        if not self.transform_on:
+            return val
+        idx = np.asarray([self._name_index.get(name, 0)], np.int64)
+        out = apply_nodes(
+            self._name_table,
+            idx,
+            np.asarray([val], np.float64),
+            nodeless_zero=True,
+        )
+        return float(out[0])
+
+    # -- serve path -------------------------------------------------------
+
+    def _resolve_hashed(self, keys: Sequence[str]) -> Tuple[np.ndarray, np.ndarray]:
+        """Raw names -> (vocab column or -1, murmur sign), cached."""
+        assert self.feature_hash is not None and self.vocab is not None
+        cache = self._hash_cache
+        vocab = self.vocab
+        fh = self.feature_hash
+        bias = self.bias_name
+        cols = np.empty(len(keys), np.int64)
+        signs = np.empty(len(keys), np.float64)
+        misses: Dict[str, Tuple[int, float]] = {}
+        for i, name in enumerate(keys):
+            hit = cache.get(name)
+            if hit is None:
+                if name == bias:
+                    hit = (-1, 1.0)
+                else:
+                    hashed, sign = fh.hash_name(name)
+                    col = vocab.get(hashed)
+                    hit = (col if col is not None else -1, sign)
+                misses[name] = hit
+            cols[i], signs[i] = hit
+        if misses:
+            with self._hash_lock:
+                if len(cache) < self._hash_cache_cap:
+                    cache.update(
+                        itertools.islice(
+                            misses.items(), self._hash_cache_cap - len(cache)
+                        )
+                    )
+        return cols, signs
+
+    def featurize(self, rows: Sequence[Dict[str, float]]) -> np.ndarray:
+        """Request dicts -> dense (B, dim) float64 in one batched stage."""
+        B = len(rows)
+        X = np.full((B, self.dim), self.fill, np.float64)
+        keys: List[str] = []
+        vals: List[float] = []
+        lens: List[int] = []
+        ke, ve, la = keys.extend, vals.extend, lens.append
+        for fmap in rows:
+            ke(fmap.keys())
+            ve(fmap.values())
+            la(len(fmap))
+        if not keys:
+            if self.bias_col is not None:
+                X[:, self.bias_col] = 1.0
+            return X
+        hashing = self.feature_hash is not None and not self.identity
+        if hashing:
+            jj, signs = self._resolve_hashed(keys)
+        else:
+            vocab = self.vocab or {}
+            # the bias name never has a vocab column (it rides bias_col),
+            # so the same lookup drops it like the per-scalar prep did
+            jj = np.fromiter(
+                map(vocab.get, keys, itertools.repeat(-1)), np.int64, len(keys)
+            )
+            signs = None
+        m = jj >= 0  # unknown features drop, as in the host walk
+        try:
+            vv = np.asarray(vals, np.float64)
+        except (ValueError, TypeError):
+            # a non-numeric value on an UNKNOWN (dropped) feature must not
+            # fail the request — the per-scalar path never converted it; a
+            # known feature's bad value still raises, like the scatter would
+            vv = np.asarray(
+                [float(v) if k else 0.0 for v, k in zip(vals, m)], np.float64
+            )
+        ii = np.repeat(np.arange(B), lens)
+        ii, jj, vv = ii[m], jj[m], vv[m]
+        if hashing and len(ii):
+            vv = vv * signs[m]
+            # collisions SUM signed values, in request order — the same
+            # float additions, in the same order, as hash_features' dict
+            # accumulation (fill is 0.0 on every hashing family)
+            np.add.at(X, (ii, jj), vv)
+            flat = np.unique(ii * np.int64(self.dim) + jj)
+            ui = flat // self.dim
+            uj = flat % self.dim
+        else:
+            X[ii, jj] = vv  # one vectorized scatter, not len(ii) writes
+            ui, uj = ii, jj
+        if self.transform_on and not self.identity and len(ui):
+            X[ui, uj] = apply_nodes(
+                self._col_table, uj, X[ui, uj], nodeless_zero=True
+            )
+        if self.bias_col is not None:
+            X[:, self.bias_col] = 1.0
+        return X

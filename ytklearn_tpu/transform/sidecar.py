@@ -1,0 +1,103 @@
+"""Transform-stat sidecar I/O + the `.bins.json` sha256 digest discipline.
+
+The `<model>_feature_transform_stat` sidecar keeps the reference text
+format for its data lines (`<name>###mode=..., mean=..., ...`) so
+reference predictors still parse it. This module adds the same
+crash-between-writes protection the bin-edge sidecar has
+(gbdt/binning.py): at model-dump time the sidecar is re-stamped with a
+sha256 digest of the model text about to land — as a `#`-prefixed
+header line, atomically, BEFORE the model file — and serve load rejects
+a sidecar whose digest names a different model text. A crash between
+the two writes leaves new-sidecar/old-model, which the mismatch turns
+into a loud load failure instead of silently skewed transforms. Legacy
+digestless sidecars (and sidecars written at ingest, before any model
+exists) load exactly as before.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Dict, Optional, Tuple
+
+DIGEST_PREFIX = "#model_digest="
+
+
+def model_text_digest(text: str) -> str:
+    """sha256 hex of model text (same recipe as gbdt/binning.py)."""
+    return hashlib.sha256(text.encode("utf-8")).hexdigest()
+
+
+def model_parts_digest(fs, model_path: str) -> Optional[str]:
+    """Digest of the dumped model: part texts concatenated in sorted
+    path order (the order every loader reads them). None when the model
+    doesn't exist yet."""
+    from ..io.fs import is_tmp_path
+
+    if not fs.exists(model_path):
+        return None
+    h = hashlib.sha256()
+    for part in sorted(fs.recur_get_paths([model_path])):
+        if is_tmp_path(part):
+            continue  # in-flight atomic_open temp from a writer
+        with fs.open(part) as f:
+            h.update(f.read().encode("utf-8"))
+    return h.hexdigest()
+
+
+def read_sidecar(fs, path: str) -> Tuple[Dict[str, object], Optional[str]]:
+    """-> (name -> TransformNode, embedded digest or None).
+
+    `#`-prefixed lines are header/comment lines (the digest stamp);
+    data lines keep the reference `name###payload` format."""
+    from ..io.reader import TransformNode
+
+    nodes: Dict[str, object] = {}
+    digest: Optional[str] = None
+    with fs.open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            if line.startswith("#"):
+                if line.startswith(DIGEST_PREFIX):
+                    digest = line[len(DIGEST_PREFIX):].strip()
+                continue
+            name, _, payload = line.partition("###")
+            nodes[name.strip()] = TransformNode.from_string(payload.strip())
+    return nodes, digest
+
+
+def verify_sidecar_digest(fs, model_path: str, digest: Optional[str]) -> None:
+    """Raise when the sidecar's embedded digest names a DIFFERENT model
+    text than what's on disk (the crash-between-writes window). A
+    digestless sidecar (legacy, or ingest-time before the model exists)
+    passes; so does a digest with no model yet (dump stamps the sidecar
+    first, so a reader racing the very first dump sees exactly that)."""
+    if digest is None:
+        return
+    actual = model_parts_digest(fs, model_path)
+    if actual is not None and actual != digest:
+        raise ValueError(
+            f"transform sidecar digest mismatch for {model_path}: sidecar "
+            f"was dumped with model text {digest[:12]}…, on-disk model is "
+            f"{actual[:12]}… — refusing to replay stale transform stats "
+            "(re-dump the model, or delete the sidecar to retrain stats)"
+        )
+
+
+def stamp_sidecar_digest(fs, sidecar_path: str, digest: str) -> None:
+    """Atomically rewrite the sidecar with `#model_digest=<hex>` as its
+    header line (replacing any previous header). Call BEFORE writing the
+    model text the digest names — the same write order as the bin-edge
+    sidecar, so the mismatch window is the detectable direction."""
+    if not fs.exists(sidecar_path):
+        return
+    with fs.open(sidecar_path) as f:
+        lines = [
+            ln for ln in f.read().splitlines()
+            if ln.strip() and not ln.lstrip().startswith("#")
+        ]
+    with fs.atomic_open(sidecar_path) as f:
+        f.write(DIGEST_PREFIX + digest + "\n")
+        for ln in lines:
+            f.write(ln + "\n")
