@@ -314,6 +314,9 @@ def main() -> int:
     errors, completed = [], [0]
     stop_evt = threading.Event()
 
+    from ytklearn_tpu.obs.recorder import thread_guard
+
+    @thread_guard
     def hammer(tid: int) -> None:
         import numpy as np
 
@@ -428,6 +431,9 @@ def main() -> int:
     stop_evt = threading.Event()
     watch_stop = threading.Event()
 
+    from ytklearn_tpu.obs.recorder import thread_guard
+
+    @thread_guard
     def slot_watch() -> None:
         # the no-double-spawn witness: sample the slot count the whole
         # drill — one instant past REPLICAS_MAX is the failure
@@ -436,6 +442,9 @@ def main() -> int:
             if n > max_slots_seen[0]:
                 max_slots_seen[0] = n
 
+    from ytklearn_tpu.obs.recorder import thread_guard
+
+    @thread_guard
     def pump() -> None:
         import numpy as np
 

@@ -1,8 +1,10 @@
 #!/usr/bin/env bash
 # Umbrella static-guard runner. A full (no-arg) invocation runs EVERY
 # guard to completion — ytklint rules (docs/static_analysis.md), the
-# knob-registry <-> running-guide doc-sync check, and the bench
-# regression gate — then reports all failures with per-check timing,
+# knob-registry <-> running-guide doc-sync check, the metric name-map
+# doc-sync check (observability.md, `tools.ytklint names check`), the
+# lint wall-time deflake guard, and the bench regression gate — then
+# reports all failures with per-check timing,
 # instead of stopping at the first failed check (a postmortem needs the
 # whole picture, not the first symptom). The 40-minute full-suite wall
 # guard joins the run with --suite (it executes the entire test suite,
@@ -55,14 +57,35 @@ run_check() {
 }
 
 # with --json the single rules run IS the artifact writer (same exit
-# semantics, and the dominant cost of the umbrella is not paid twice)
+# semantics, and the dominant cost of the umbrella is not paid twice);
+# without it the timing block still lands in a temp artifact so the
+# deflake guard below always has something to read
 if [ -n "$JSON_OUT" ]; then
     run_check "ytklint-rules" sh -c \
         "python -m tools.ytklint --format json > '$JSON_OUT'"
+    TIMING_SRC="$JSON_OUT"
 else
-    run_check "ytklint-rules" python -m tools.ytklint
+    TIMING_SRC="$(mktemp /tmp/ytklint_timing.XXXXXX.json)"
+    trap 'rm -f "$TIMING_SRC"' EXIT
+    run_check "ytklint-rules" python -m tools.ytklint --timing-out "$TIMING_SRC"
 fi
 run_check "knob-doc-sync"  python -m ytklearn_tpu.config.knobs check docs/running_guide.md
+run_check "metric-doc-sync" python -m tools.ytklint names check
+# deflake guard: the interprocedural flow pass must stay within
+# TIME_BUDGET_RATIO x the pre-ytkflow baseline (parse + per-file rules),
+# as recorded in the json artifact's "timing" block
+run_check "lint-time-guard" python - "$TIMING_SRC" <<'PY'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+t = doc.get("timing") or {}
+if "within_budget" not in t:
+    sys.exit("lint-time-guard: no budget verdict in %s (selected run?)" % sys.argv[1])
+msg = ("total %.2fs vs baseline %.2fs -> ratio %.2f (budget %.1fx)" % (
+    t["total_seconds"], t["baseline_seconds"], t["ratio"], t["budget_ratio"]))
+if not t["within_budget"]:
+    sys.exit("lint-time-guard: OVER BUDGET — " + msg)
+print("lint-time-guard:", msg)
+PY
 run_check "bench-regress"  python scripts/check_bench_regress.py
 if [ "$WITH_SUITE" -eq 1 ]; then
     run_check "suite-time" scripts/check_suite_time.sh

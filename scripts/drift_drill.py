@@ -132,6 +132,9 @@ def _drive(front, rng, n_rows, shift=None, per_request=8, threads=6):
     done = [0] * threads
     errors = []
 
+    from ytklearn_tpu.obs.recorder import thread_guard
+
+    @thread_guard
     def worker(k):
         for i in range(k, len(batches), threads):
             try:

@@ -133,6 +133,9 @@ def _hog_abuse(front, n_requests: int, threads: int = 16,
     counts = {"ok": 0, "shed_429": 0, "expired_504": 0, "other": 0}
     lock = threading.Lock()
 
+    from ytklearn_tpu.obs.recorder import thread_guard
+
+    @thread_guard
     def client(k):
         for i in range(k, len(batches), threads):
             try:

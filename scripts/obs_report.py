@@ -597,6 +597,7 @@ def render_model_metrics(block: Optional[dict]) -> None:
     for name, mb in sorted(block["models"].items()):
         c = mb.get("counters") or {}
         lat = mb.get("latency") or {}
+        # ytklint: allow(metric-name-drift) reason=per-model counters are suffix keys within the serve.model.<scope> namespace, not top-level registry names
         hit, miss = c.get("cache.hit", 0.0), c.get("cache.miss", 0.0)
         hit_pct = f"{100.0 * hit / (hit + miss):.1f}" if hit + miss else "-"
         slo = mb.get("slo") or {}

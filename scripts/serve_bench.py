@@ -473,6 +473,9 @@ def _drive_app_threads(app, rows, seconds, threads=16):
     stop = [False]
     counts = [0] * threads
 
+    from ytklearn_tpu.obs.recorder import thread_guard
+
+    @thread_guard
     def worker(k):
         i = k
         while not stop[0]:
@@ -752,7 +755,9 @@ def bench_front_http(front, frags, rows_per_body, seconds, threads, log):
         stop = [False]
         counts = [0] * threads
         errors = [0] * threads
+        from ytklearn_tpu.obs.recorder import thread_guard
 
+        @thread_guard
         def worker(k):
             conn = http.client.HTTPConnection(
                 "127.0.0.1", front.port, timeout=60)
@@ -1054,6 +1059,9 @@ def ramp_main(args, log) -> int:
         samples = []  # (t, ready, slots, backlog)
         sampler_stop = threading.Event()
 
+        from ytklearn_tpu.obs.recorder import thread_guard
+
+        @thread_guard
         def sampler():
             t0s = time.perf_counter()
             while not sampler_stop.wait(0.25):

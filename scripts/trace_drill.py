@@ -133,6 +133,9 @@ def traced_fleet_step(args, tmp_dir, frags, record_dir) -> dict:
     errors = []
     stop = [False]
 
+    from ytklearn_tpu.obs.recorder import thread_guard
+
+    @thread_guard
     def worker(k):
         conn = http.client.HTTPConnection("127.0.0.1", front.port,
                                           timeout=120.0)

@@ -390,3 +390,49 @@ def test_real_higgs_loader_has_ingest_spans(obs_on, tmp_path):
     assert snap["counters"]["ingest.rows"] == 50.0
     names = {e["name"] for e in obs.REGISTRY.events}
     assert "ingest.parse" in names
+
+
+# ---------------------------------------------------------------------------
+# thread_guard: a worker thread must not die silently
+# ---------------------------------------------------------------------------
+
+
+def test_thread_guard_logs_records_and_reraises(obs_on):
+    from ytklearn_tpu.obs.recorder import thread_guard
+
+    @thread_guard
+    def entry(x):
+        raise ValueError(f"boom {x}")
+
+    assert entry.__name__ == "entry"  # functools.wraps
+    with pytest.raises(ValueError, match="boom 7"):
+        entry(7)
+    died = [e for e in obs.REGISTRY.events if e["name"] == "thread.died"]
+    assert len(died) == 1
+    assert died[0]["args"]["error"] == "ValueError"
+    assert "entry" in died[0]["args"]["entry"]
+
+
+def test_thread_guard_passthrough_on_success(obs_on):
+    from ytklearn_tpu.obs.recorder import thread_guard
+
+    @thread_guard
+    def entry(a, b=1):
+        return a + b
+
+    assert entry(2, b=3) == 5
+    assert [e for e in obs.REGISTRY.events if e["name"] == "thread.died"] == []
+
+
+def test_exports_commit_atomically(obs_on, tmp_path):
+    # the exporters now write through the fs seam: tmp-file + atomic
+    # replace, no stray tmp artifacts left next to the export
+    obs.inc("rows", 1)
+    for name, fn in (("t.json", obs.export_chrome_trace),
+                     ("e.jsonl", obs.export_jsonl)):
+        out = tmp_path / name
+        fn(str(out))
+        assert out.exists()
+        stray = [p.name for p in tmp_path.iterdir() if p.name != name]
+        assert stray == [], stray
+        out.unlink()

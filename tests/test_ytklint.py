@@ -18,6 +18,7 @@ from tools.ytklint import (
     lint_paths_report,
     lint_source,
     lint_source_report,
+    lint_sources,
     report_json,
 )
 from ytklearn_tpu.config import knobs
@@ -44,12 +45,26 @@ def test_rule_catalog_is_the_issue_catalog():
         "lock-order-inversion",
         "blocking-call-under-lock",
         "thread-lifecycle",
+        # the ytkflow interprocedural pass (tools/ytklint/flow.py)
+        "unseamed-io",
+        "metric-name-drift",
+        "deep-blocking-under-lock",
+        "deep-host-sync-in-jit",
+        "silent-thread-death",
     }
     for r in RULES.values():
         assert r.doc  # every rule documents itself for --list-rules
+    # the flow rules run in the post-graph phase, the rest per-file
+    assert {r.name for r in RULES.values() if r.needs_graph} == {
+        "unseamed-io", "metric-name-drift", "deep-blocking-under-lock",
+        "deep-host-sync-in-jit", "silent-thread-death",
+    }
     # serve-lock-discipline graduated into unguarded-shared-write; the
     # alias keeps old suppressions/--select invocations valid
     assert RULE_ALIASES["serve-lock-discipline"] == "unguarded-shared-write"
+    # the deep rules grew out of the 1-level pass; short spellings stay
+    assert RULE_ALIASES["cross-module-blocking"] == "deep-blocking-under-lock"
+    assert RULE_ALIASES["cross-module-host-sync"] == "deep-host-sync-in-jit"
 
 
 # ---------------------------------------------------------------------------
@@ -327,6 +342,7 @@ def test_sleep_in_except_fails():
     def fetch(path):
         for _ in range(3):
             try:
+                # ytklint: allow(unseamed-io) reason=fixture
                 return open(path).read()
             except OSError:
                 time.sleep(1.0)
@@ -338,6 +354,7 @@ def test_sleep_in_except_fails():
 
     def fetch(path):
         try:
+            # ytklint: allow(unseamed-io) reason=fixture
             return open(path).read()
         except OSError:
             sleep(0.5)
@@ -465,6 +482,7 @@ def test_unguarded_shared_write_thread_escape_iteration():
             self._t = None
 
         def start(self):
+            # ytklint: allow(silent-thread-death) reason=fixture
             self._t = threading.Thread(target=self._monitor, daemon=True)
             self._t.start()
 
@@ -491,6 +509,7 @@ def test_unguarded_shared_write_common_lock_passes():
             self._t = None
 
         def start(self):
+            # ytklint: allow(silent-thread-death) reason=fixture
             self._t = threading.Thread(target=self._monitor, daemon=True)
             self._t.start()
 
@@ -712,6 +731,7 @@ def test_blocking_call_one_level_propagation():
     _lock = threading.Lock()
 
     def _build():
+        # ytklint: allow(unseamed-io) reason=fixture
         subprocess.run(["cc", "native.c"], check=True)
 
     def load():
@@ -731,7 +751,7 @@ def test_blocking_call_under_lock_suppression():
 
     def load():
         with _lock:
-            # ytklint: allow(blocking-call-under-lock) reason=fixture: build serialization is the point
+            # ytklint: allow(blocking-call-under-lock, unseamed-io) reason=fixture: build serialization is the point
             subprocess.run(["cc"], check=True)
     """
     assert run(src) == []
@@ -1008,3 +1028,500 @@ def test_lint_paths_refuses_zero_file_runs(tmp_path):
     empty.mkdir()
     with pytest.raises(FileNotFoundError):
         lint_paths([str(empty)])
+
+
+# ---------------------------------------------------------------------------
+# the ytkflow interprocedural pass (tools/ytklint/flow.py)
+# ---------------------------------------------------------------------------
+
+
+def runs(sources, select=None):
+    return lint_sources(
+        {p: textwrap.dedent(s) for p, s in sources.items()}, select
+    )
+
+
+# -- unseamed-io -------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "body",
+    [
+        "with open(p) as f:\n            return f.read()",
+        "os.replace(p, p + '.new')",
+        "shutil.rmtree(p)",
+        "subprocess.check_call(['ls', p])",
+    ],
+)
+def test_unseamed_io_fails(body):
+    src = f"""\
+    import os
+    import shutil
+    import subprocess
+
+    def f(p):
+        {body}
+    """
+    assert "unseamed-io" in rules_hit(src)
+
+
+def test_unseamed_io_module_level_read_is_flagged():
+    src = """\
+    import os
+
+    CONF = open("defaults.hocon").read()
+    """
+    found = run(src)
+    assert [f.rule for f in found] == ["unseamed-io"]
+    assert "module level" in found[0].message
+
+
+def test_unseamed_io_blessed_seams_and_exempt_calls_pass():
+    # the fs seam file itself may do raw IO — it IS the seam
+    seam = """\
+    import os
+
+    def commit(tmp, path):
+        os.replace(tmp, path)
+    """
+    assert runs({"ytklearn_tpu/io/fs.py": seam}) == []
+    # urllib.parse is string manipulation; gethostname is a local lookup
+    clean = """\
+    import socket
+    import urllib.parse
+
+    def f(url):
+        q = urllib.parse.urlsplit(url).query
+        return socket.gethostname(), urllib.parse.parse_qs(q)
+    """
+    assert run(clean) == []
+    # scripts/ and tools/ are outside the seam contract
+    raw = """\
+    def f(p):
+        return open(p).read()
+    """
+    assert runs({"scripts/adhoc.py": raw}) == []
+
+
+def test_unseamed_io_reports_cross_module_reach():
+    # the finding on the callee names a caller from another module, so
+    # the reader sees how production code reaches the raw primitive
+    found = runs({
+        "ytklearn_tpu/aaa.py": """\
+            from ytklearn_tpu.bbb import dump
+
+            def save(doc, p):
+                dump(doc, p)
+            """,
+        "ytklearn_tpu/bbb.py": """\
+            def dump(doc, p):
+                with open(p, "w") as f:
+                    f.write(doc)
+            """,
+    })
+    hits = [f for f in found if f.rule == "unseamed-io"]
+    assert len(hits) == 1
+    assert hits[0].path == "ytklearn_tpu/bbb.py"
+    assert "reached from ytklearn_tpu.aaa.save" in hits[0].message
+
+
+def test_unseamed_io_suppression():
+    src = """\
+    def f():
+        # ytklint: allow(unseamed-io) reason=/proc read, fixture
+        with open("/proc/self/status") as fh:
+            return fh.read()
+    """
+    assert run(src) == []
+
+
+# -- metric-name-drift -------------------------------------------------------
+
+
+def test_metric_name_drift_orphan_consumer_fails():
+    # a sentinel watching a name nobody emits is exactly the bug this
+    # rule exists for — the consumer file is the finding site
+    found = runs({
+        "ytklearn_tpu/obs/health.py": """\
+            def check(snap):
+                return snap["counters"].get("nobody.emits_this", 0.0)
+            """,
+    })
+    hits = [f for f in found if f.rule == "metric-name-drift"]
+    assert len(hits) == 1
+    assert "nobody.emits_this" in hits[0].message
+
+
+def test_metric_name_drift_satisfied_by_producer_and_prefix():
+    found = runs({
+        "ytklearn_tpu/prod.py": """\
+            from ytklearn_tpu.obs import inc, gauge
+
+            def work(model):
+                inc("serve.requests")
+                gauge(f"serve.model.{model}.latency", 1.0)
+            """,
+        "scripts/obs_report.py": """\
+            def render(snap):
+                c = snap["counters"]
+                return c.get("serve.requests"), c.get("serve.model.a.latency")
+            """,
+    })
+    assert [f for f in found if f.rule == "metric-name-drift"] == []
+
+
+def test_metric_name_drift_ignores_non_metric_literals():
+    src = """\
+    import logging
+
+    log = logging.getLogger("ytklearn_tpu.serve.front")
+
+    def render(paths):
+        import os.path
+        return os.path.join("bench_out", "higgs.train")
+    """
+    assert runs({"bench.py": src}) == []
+
+
+def test_metric_name_drift_suppression():
+    found = runs({
+        "scripts/obs_report.py": """\
+            def render(mb):
+                c = mb.get("counters") or {}
+                # ytklint: allow(metric-name-drift) reason=suffix keys, fixture
+                return c.get("cache.hit", 0.0), c.get("cache.miss", 0.0)
+            """,
+    })
+    assert [f for f in found if f.rule == "metric-name-drift"] == []
+
+
+# -- deep-blocking-under-lock ------------------------------------------------
+
+
+# the r14 respawn-bug shape, planted through a module boundary: the
+# monitor holds its lock across a call into worker.py, and the callee
+# blocks on proc.wait() — invisible to the 1-level per-module pass
+_FRONT_SRC = """\
+    import threading
+
+    from ytklearn_tpu.workerx import drain_replica
+
+    class Front:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.replicas = {}
+
+        def restart(self, rid):
+            with self._lock:
+                h = self.replicas.pop(rid)
+                drain_replica(h)
+    """
+
+_WORKER_SRC = """\
+    import subprocess
+
+    def drain_replica(h):
+        h.proc.terminate()
+        # ytklint: allow(unseamed-io) reason=fixture
+        subprocess.run(["kill", str(h.pid)], check=True)
+    """
+
+
+def test_deep_blocking_under_lock_cross_module_plant():
+    found = runs({
+        "ytklearn_tpu/frontx.py": _FRONT_SRC,
+        "ytklearn_tpu/workerx.py": _WORKER_SRC,
+    })
+    hits = [f for f in found if f.rule == "deep-blocking-under-lock"]
+    assert len(hits) == 1
+    assert hits[0].path == "ytklearn_tpu/frontx.py"
+    # the finding prints the resolved chain and the terminal primitive
+    assert ("ytklearn_tpu.frontx.Front.restart -> "
+            "ytklearn_tpu.workerx.drain_replica") in hits[0].message
+    assert "ytklearn_tpu/workerx.py" in hits[0].message
+
+
+def test_deep_blocking_outside_lock_passes():
+    src = _FRONT_SRC.replace(
+        "with self._lock:\n                h = self.replicas.pop(rid)\n"
+        "                drain_replica(h)",
+        "h = self.replicas.pop(rid)\n            drain_replica(h)")
+    found = runs({
+        "ytklearn_tpu/frontx.py": src,
+        "ytklearn_tpu/workerx.py": _WORKER_SRC,
+    })
+    assert [f for f in found if f.rule == "deep-blocking-under-lock"] == []
+
+
+def test_deep_blocking_same_module_one_hop_is_not_duplicated():
+    # a 1-level same-module chain is blocking-call-under-lock's finding;
+    # the deep rule must not double-report it
+    src = """\
+    import subprocess, threading
+
+    _lock = threading.Lock()
+
+    def stop(h):
+        # ytklint: allow(unseamed-io) reason=fixture
+        subprocess.run(["kill", str(h.pid)], check=True)
+
+    def restart(h):
+        with _lock:
+            stop(h)
+    """
+    found = run(src)
+    assert "blocking-call-under-lock" in {f.rule for f in found}
+    assert "deep-blocking-under-lock" not in {f.rule for f in found}
+
+
+def test_deep_blocking_suppression_accepts_issue_alias():
+    src = _FRONT_SRC.replace(
+        "drain_replica(h)",
+        "# ytklint: allow(cross-module-blocking) reason=fixture\n"
+        "                drain_replica(h)")
+    found = runs({
+        "ytklearn_tpu/frontx.py": src,
+        "ytklearn_tpu/workerx.py": _WORKER_SRC,
+    })
+    assert [f for f in found if f.rule == "deep-blocking-under-lock"] == []
+
+
+# -- deep-host-sync-in-jit ---------------------------------------------------
+
+
+def test_deep_host_sync_cross_module_plant():
+    found = runs({
+        "ytklearn_tpu/jitted.py": """\
+            import jax
+
+            from ytklearn_tpu.helperx import to_scalar
+
+            @jax.jit
+            def step(x):
+                return to_scalar(x)
+            """,
+        "ytklearn_tpu/helperx.py": """\
+            def to_scalar(x):
+                return x.item()
+            """,
+    })
+    hits = [f for f in found if f.rule == "deep-host-sync-in-jit"]
+    assert len(hits) == 1
+    assert hits[0].path == "ytklearn_tpu/jitted.py"
+    assert ("ytklearn_tpu.helperx.to_scalar" in hits[0].message
+            and ".item()" in hits[0].message)
+
+
+def test_deep_host_sync_clean_helper_passes():
+    found = runs({
+        "ytklearn_tpu/jitted.py": """\
+            import jax
+
+            from ytklearn_tpu.helperx import double
+
+            @jax.jit
+            def step(x):
+                return double(x)
+            """,
+        "ytklearn_tpu/helperx.py": """\
+            def double(x):
+                return x * 2
+            """,
+    })
+    assert [f for f in found if f.rule == "deep-host-sync-in-jit"] == []
+
+
+# -- silent-thread-death -----------------------------------------------------
+
+
+def test_silent_thread_death_fails():
+    src = """\
+    import threading
+
+    def worker(q):
+        while True:
+            item = q.get()
+            item.process()
+
+    def start(q):
+        t = threading.Thread(target=worker, args=(q,), daemon=True)
+        t.start()
+        return t
+    """
+    found = run(src)
+    hits = [f for f in found if f.rule == "silent-thread-death"]
+    assert len(hits) == 1
+    assert "worker" in hits[0].message and "thread_guard" in hits[0].message
+
+
+def test_silent_thread_death_guarded_entries_pass():
+    # decorator form
+    src = """\
+    import threading
+
+    from ytklearn_tpu.obs.recorder import thread_guard
+
+    @thread_guard
+    def worker(q):
+        while True:
+            q.get().process()
+
+    def start(q):
+        t = threading.Thread(target=worker, args=(q,), daemon=True)
+        t.start()
+    """
+    assert run(src) == []
+    # handler form: a broad except that logs covers the loop body
+    src2 = """\
+    import threading
+    import logging
+
+    log = logging.getLogger(__name__)
+
+    def worker(q):
+        try:
+            while True:
+                q.get().process()
+        except Exception:
+            log.exception("worker died")
+
+    def start(q):
+        t = threading.Thread(target=worker, args=(q,), daemon=True)
+        t.start()
+    """
+    assert run(src2) == []
+
+
+def test_silent_thread_death_risky_call_inside_handler_still_fails():
+    # the except body itself can raise — only the try BODY is covered
+    src = """\
+    import threading
+    import logging
+
+    log = logging.getLogger(__name__)
+
+    def worker(q):
+        try:
+            while True:
+                q.get().process()
+        except Exception:
+            q.rollback()
+
+    def start(q):
+        t = threading.Thread(target=worker, args=(q,), daemon=True)
+        t.start()
+    """
+    found = run(src)
+    assert "silent-thread-death" in {f.rule for f in found}
+
+
+def test_silent_thread_death_suppression():
+    src = """\
+    import threading
+
+    def worker(q):
+        q.get().process()
+
+    def start(q):
+        # ytklint: allow(silent-thread-death) reason=fixture
+        t = threading.Thread(target=worker, args=(q,), daemon=True)
+        t.start()
+    """
+    assert run(src) == []
+
+
+# -- stale-suppression audit covers the flow rules ---------------------------
+
+
+def test_unused_flow_suppression_is_flagged():
+    # a suppression for a graph rule that no longer fires is inventory
+    # drift, same as the per-file rules (and aliases resolve first)
+    src = """\
+    def f(p):
+        # ytklint: allow(unseamed-io) reason=stale, nothing raw below
+        return p.upper()
+    """
+    found = run(src)
+    assert [f.rule for f in found] == ["unused-suppression"]
+    src2 = """\
+    def f(h):
+        # ytklint: allow(cross-module-blocking) reason=stale alias form
+        return h.name
+    """
+    found2 = run(src2)
+    assert [f.rule for f in found2] == ["unused-suppression"]
+    assert "deep-blocking-under-lock" in found2[0].message
+
+
+# -- timing artifact + deflake budget ----------------------------------------
+
+
+def test_timing_block_in_report_and_json():
+    report = lint_paths_report(["bench.py"])
+    t = report["timing"]
+    assert t["parse_seconds"] >= 0.0
+    assert t["graph_seconds"] >= 0.0
+    assert t["total_seconds"] >= t["parse_seconds"]
+    assert set(t["rule_seconds"]) <= set(RULES)
+    # the deflake verdict: full runs carry the baseline comparison
+    assert t["budget_ratio"] == 1.5
+    assert isinstance(t["within_budget"], bool)
+    doc = report_json(report)
+    assert doc["schema_version"] == 2
+    assert doc["timing"] == t
+    # a selected run cannot claim a budget verdict (the baseline rules
+    # did not all run)
+    sel = lint_paths_report(["bench.py"], ["bare-print"])
+    assert "within_budget" not in sel["timing"]
+
+
+# -- metric name map doc sync ------------------------------------------------
+
+
+def test_metric_doc_sync_both_ways(tmp_path, monkeypatch):
+    import pathlib
+
+    from tools.ytklint import flow
+
+    monkeypatch.chdir(pathlib.Path(__file__).resolve().parent.parent)
+    census = flow.census_for_repo()
+    # the checked-in doc is in sync (the CI gate)
+    assert flow.check_doc_sync(
+        pathlib.Path("docs/observability.md"), census) == []
+    # a drifted copy fails loudly, and regen repairs it
+    doc = tmp_path / "obs.md"
+    doc.write_text(
+        f"# obs\n\n{flow.DOC_BEGIN}\nstale\n{flow.DOC_END}\n",
+        encoding="utf-8")
+    problems = flow.check_doc_sync(doc, census)
+    assert problems and "stale" in problems[0]
+    flow.regen_doc(doc, census)
+    assert flow.check_doc_sync(doc, census) == []
+    # missing markers are their own failure, not a silent pass
+    bare = tmp_path / "bare.md"
+    bare.write_text("# no markers\n", encoding="utf-8")
+    assert any("markers" in p for p in flow.check_doc_sync(bare, census))
+
+
+# -- --changed-only ----------------------------------------------------------
+
+
+def test_changed_files_lists_repo_paths_and_rejects_bad_refs():
+    from tools.ytklint.core import changed_files
+
+    got = changed_files("HEAD")
+    assert isinstance(got, set)
+    assert all(isinstance(p, str) and not p.startswith("/") for p in got)
+    with pytest.raises(RuntimeError):
+        changed_files("no-such-ref-anywhere")
+
+
+def test_changed_only_filters_findings_but_keeps_graph(capsys, tmp_path):
+    # a finding in an UNchanged file is filtered out; the whole-repo
+    # graph was still built (the summary line says so)
+    from tools.ytklint.core import main
+
+    rc = main(["--changed-only", "--base", "HEAD", "bench.py"])
+    err = capsys.readouterr().err
+    assert "whole-repo graph still built" in err
+    assert rc in (0, 1)

@@ -8,7 +8,10 @@ opposite orders on two thread paths. ytklint is a small AST framework
 (core.py) plus the per-file rules (rules.py) and the cross-method
 concurrency pass (concurrency.py: guarded-state map, lock-order graph,
 blocking-IO-under-lock, thread lifecycle — runtime twin: pytest
---ytk-lockwatch, lockwatch.py), with an inline suppression syntax:
+--ytk-lockwatch, lockwatch.py), and the whole-repo interprocedural
+flow pass (flow.py: IO-seam coverage, metric-name census, deep
+cross-module lock/jit chains, silent thread death), with an inline
+suppression syntax:
 
     # ytklint: allow(<rule>[, <rule>]) reason=<non-empty explanation>
 
@@ -26,8 +29,11 @@ from .core import (  # noqa: F401
     lint_paths_report,
     lint_source,
     lint_source_report,
+    lint_sources,
+    lint_sources_report,
     main,
     report_json,
 )
 from . import rules  # noqa: F401  — importing registers the rule set
 from . import concurrency  # noqa: F401  — registers the concurrency rules
+from . import flow  # noqa: F401  — registers the interprocedural rules

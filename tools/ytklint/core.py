@@ -32,6 +32,7 @@ import ast
 import pathlib
 import re
 import sys
+import time
 from dataclasses import dataclass
 from typing import Callable, Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
@@ -57,6 +58,11 @@ class Rule:
     doc: str
     check: Callable
     applies: Callable[[str], bool]
+    # graph rules (flow.py) read ctx.flow and run in a second phase,
+    # AFTER every per-file rule — so the per-file pass pays for (and the
+    # timing baseline honestly reflects) its own per-module analyses,
+    # and graph_seconds carries only the flow pass's marginal cost
+    needs_graph: bool = False
 
 
 RULES: Dict[str, Rule] = {}
@@ -69,7 +75,23 @@ RULES: Dict[str, Rule] = {}
 RULE_ALIASES = {
     "broad-except": "broad-except-swallow",
     "serve-lock-discipline": "unguarded-shared-write",
+    # the ytkflow deep rules grew out of the 1-level concurrency pass;
+    # the short spellings keep suppressions readable at call sites
+    "cross-module-blocking": "deep-blocking-under-lock",
+    "cross-module-host-sync": "deep-host-sync-in-jit",
 }
+
+# the rule set that existed before the ytkflow interprocedural pass —
+# the deflake budget in check_lint.sh compares a full run against the
+# cost of parsing + running only these (see report_json "timing")
+PRE_FLOW_RULES = (
+    "host-sync-in-jit", "retrace-hazard", "undeclared-knob",
+    "broad-except-swallow", "bare-print", "sleep-in-except",
+    "blocking-call-under-lock", "thread-lifecycle", "unguarded-shared-write",
+    "lock-order-inversion",
+)
+
+TIME_BUDGET_RATIO = 1.5
 
 
 def resolve_rule_name(name: str) -> str:
@@ -80,13 +102,16 @@ def _applies_everywhere(path: str) -> bool:
     return True
 
 
-def rule(name: str, doc: str, applies: Optional[Callable] = None):
-    """Register a rule. `applies(relpath)` scopes it to part of the tree."""
+def rule(name: str, doc: str, applies: Optional[Callable] = None,
+         needs_graph: bool = False):
+    """Register a rule. `applies(relpath)` scopes it to part of the tree.
+    `needs_graph=True` defers it to the post-graph phase (ctx.flow)."""
 
     def deco(fn):
         if name in RULES:
             raise ValueError(f"duplicate rule {name!r}")
-        RULES[name] = Rule(name, doc, fn, applies or _applies_everywhere)
+        RULES[name] = Rule(name, doc, fn, applies or _applies_everywhere,
+                           needs_graph)
         return fn
 
     return deco
@@ -100,6 +125,9 @@ class FileContext:
         self.source = source
         self.lines = source.splitlines()
         self.tree = ast.parse(source, path)
+        # whole-repo flow graph (tools/ytklint/flow.py), attached by the
+        # runner via GRAPH_BUILDERS before any rule sees this context
+        self.flow = None
         # line -> {rule name -> (comment line, reason)}
         self.allows: Dict[int, Dict[str, Tuple[int, str]]] = {}
         # every well-formed suppression: (comment line, rule, reason)
@@ -165,9 +193,18 @@ class FileReport:
 
 
 def _run_rules(
-    ctx: FileContext, select: Optional[Sequence[str]]
+    ctx: FileContext,
+    select: Optional[Sequence[str]],
+    rule_seconds: Optional[Dict[str, float]] = None,
+    graph_phase: Optional[bool] = None,
 ) -> FileReport:
-    findings: List[Finding] = list(ctx.bad_suppressions)
+    """Run the rule set on one file. `graph_phase` restricts to the
+    per-file rules (False) or the graph rules (True); None runs both.
+    Malformed-suppression findings are emitted only on the per-file
+    phase so a two-phase run reports each exactly once."""
+    findings: List[Finding] = (
+        [] if graph_phase else list(ctx.bad_suppressions)
+    )
     suppressed: List[dict] = []
     used: Set[Tuple[int, str]] = set()
     selected = (
@@ -176,12 +213,20 @@ def _run_rules(
     )
     ran: Set[str] = set()
     for r in RULES.values():
+        if graph_phase is not None and r.needs_graph is not graph_phase:
+            continue
         if selected is not None and r.name not in selected:
             continue
         ran.add(r.name)
         if not r.applies(ctx.path):
             continue
-        for line, msg in r.check(ctx):
+        t0 = time.perf_counter()
+        hits = list(r.check(ctx))
+        if rule_seconds is not None:
+            rule_seconds[r.name] = (
+                rule_seconds.get(r.name, 0.0) + time.perf_counter() - t0
+            )
+        for line, msg in hits:
             hit = ctx.allowed(r.name, line)
             if hit is None:
                 findings.append(Finding(ctx.path, line, r.name, msg))
@@ -208,6 +253,18 @@ def _run_rules(
     return FileReport(findings, suppressed)
 
 
+# Whole-repo graph builders (tools/ytklint/flow.py registers one).
+# Each is called with the full list of parsed FileContexts before any
+# rule runs, and attaches whatever it builds as ``ctx.flow`` — this
+# keeps core free of an import cycle (flow imports ``rule`` from here).
+GRAPH_BUILDERS: List[Callable[[List["FileContext"]], None]] = []
+
+
+def _attach_graphs(ctxs: List[FileContext]) -> None:
+    for builder in GRAPH_BUILDERS:
+        builder(ctxs)
+
+
 def lint_source(
     source: str, path: str, select: Optional[Sequence[str]] = None
 ) -> List[Finding]:
@@ -218,13 +275,45 @@ def lint_source(
 def lint_source_report(
     source: str, path: str, select: Optional[Sequence[str]] = None
 ) -> FileReport:
-    try:
-        ctx = FileContext(path, source)
-    except SyntaxError as e:
-        return FileReport(
-            [Finding(path, e.lineno or 1, "syntax-error", str(e.msg))], []
-        )
-    return _run_rules(ctx, select)
+    rep = lint_sources_report({path: source}, select)
+    return FileReport(rep["findings"], rep["suppressed"])
+
+
+def lint_sources(
+    sources: Dict[str, str], select: Optional[Sequence[str]] = None
+) -> List[Finding]:
+    return lint_sources_report(sources, select)["findings"]
+
+
+def lint_sources_report(
+    sources: Dict[str, str], select: Optional[Sequence[str]] = None
+) -> dict:
+    """Lint a set of virtual files {repo-relative path: source} as one
+    unit: the flow graph is built over exactly this set, so fixtures can
+    plant cross-module call chains without touching the real tree."""
+    findings: List[Finding] = []
+    suppressed: List[dict] = []
+    ctxs: List[FileContext] = []
+    for path, source in sources.items():
+        try:
+            ctxs.append(FileContext(path, source))
+        except SyntaxError as e:
+            findings.append(
+                Finding(path.replace("\\", "/"), e.lineno or 1,
+                        "syntax-error", str(e.msg))
+            )
+    for ctx in ctxs:
+        rep = _run_rules(ctx, select, graph_phase=False)
+        findings.extend(rep.findings)
+        suppressed.extend(rep.suppressed)
+    _attach_graphs(ctxs)
+    for ctx in ctxs:
+        rep = _run_rules(ctx, select, graph_phase=True)
+        findings.extend(rep.findings)
+        suppressed.extend(rep.suppressed)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return {"findings": findings, "suppressed": suppressed,
+            "files": len(sources)}
 
 
 # path-scoped rules (bare-print, the concurrency set's serve heritage)
@@ -257,6 +346,40 @@ def _iter_py_files(paths: Sequence[str]) -> Iterable[pathlib.Path]:
             )
 
 
+# Shared AST cache: one parse per file per process, keyed on
+# (mtime_ns, size) so edits invalidate. Every umbrella entry point —
+# the rules run, the doc-sync census, repeated lint_paths calls in the
+# test suite — draws from the same parsed contexts.
+_AST_CACHE: Dict[str, Tuple[Tuple[int, int], object]] = {}
+
+
+def _context_for(f: pathlib.Path, rel: str):
+    """FileContext for `f`, or a syntax-error Finding. Cached."""
+    key = str(f.resolve())
+    st = f.stat()
+    sig = (st.st_mtime_ns, st.st_size)
+    hit = _AST_CACHE.get(key)
+    if hit is not None and hit[0] == sig:
+        return hit[1]
+    try:
+        got: object = FileContext(rel, f.read_text(encoding="utf-8"))
+    except SyntaxError as e:
+        got = Finding(rel, e.lineno or 1, "syntax-error", str(e.msg))
+    _AST_CACHE[key] = (sig, got)
+    return got
+
+
+def contexts_for_paths(paths: Sequence[str]) -> List[FileContext]:
+    """Parsed contexts for every .py file under `paths` (cache-backed);
+    syntax-error files are skipped. Used by the flow census CLI."""
+    out = []
+    for f in _iter_py_files(paths):
+        got = _context_for(f, _rel(f))
+        if isinstance(got, FileContext):
+            out.append(got)
+    return out
+
+
 def lint_paths(
     paths: Sequence[str], select: Optional[Sequence[str]] = None
 ) -> List[Finding]:
@@ -266,20 +389,75 @@ def lint_paths(
 def lint_paths_report(
     paths: Sequence[str], select: Optional[Sequence[str]] = None
 ) -> dict:
-    """-> {"findings": [Finding], "suppressed": [dict], "files": int}."""
+    """-> {"findings", "suppressed", "files", "timing"}."""
     findings: List[Finding] = []
     suppressed: List[dict] = []
+    ctxs: List[FileContext] = []
     n_files = 0
+    t0 = time.perf_counter()
     for f in _iter_py_files(paths):
         n_files += 1
-        rep = lint_source_report(f.read_text(encoding="utf-8"), _rel(f), select)
-        findings.extend(rep.findings)
-        suppressed.extend(rep.suppressed)
+        got = _context_for(f, _rel(f))
+        if isinstance(got, Finding):
+            findings.append(got)
+        else:
+            ctxs.append(got)
+    parse_s = time.perf_counter() - t0
     if n_files == 0:
         raise FileNotFoundError(
             f"ytklint: no .py files under {list(paths)!r}"
         )
-    return {"findings": findings, "suppressed": suppressed, "files": n_files}
+    rule_seconds: Dict[str, float] = {}
+    # phase 1: the per-file rule set — exactly the pre-ytkflow pass, so
+    # its cost (including the per-module concurrency/trace analyses it
+    # computes for itself) IS the deflake baseline
+    for ctx in ctxs:
+        rep = _run_rules(ctx, select, rule_seconds, graph_phase=False)
+        findings.extend(rep.findings)
+        suppressed.extend(rep.suppressed)
+    # phase 2: whole-repo graph build (reuses the per-file analyses via
+    # their ctx caches — graph_seconds is the flow pass's marginal cost)
+    # + the graph rules
+    t0 = time.perf_counter()
+    _attach_graphs(ctxs)
+    graph_s = time.perf_counter() - t0
+    for ctx in ctxs:
+        rep = _run_rules(ctx, select, rule_seconds, graph_phase=True)
+        findings.extend(rep.findings)
+        suppressed.extend(rep.suppressed)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    timing = _timing_block(parse_s, graph_s, rule_seconds, select)
+    return {"findings": findings, "suppressed": suppressed,
+            "files": n_files, "timing": timing}
+
+
+def _timing_block(
+    parse_s: float, graph_s: float, rule_seconds: Dict[str, float],
+    select: Optional[Sequence[str]],
+) -> dict:
+    """Per-rule wall time plus the deflake budget: a full run must cost
+    ≤ TIME_BUDGET_RATIO × what parsing + the pre-ytkflow rule set costs
+    on the same tree (the shared AST cache pays for the flow pass).
+    The budget verdict is only meaningful on an unselected run."""
+    total = parse_s + graph_s + sum(rule_seconds.values())
+    timing = {
+        "parse_seconds": round(parse_s, 6),
+        "graph_seconds": round(graph_s, 6),
+        "rule_seconds": {k: round(v, 6) for k, v in sorted(rule_seconds.items())},
+        "total_seconds": round(total, 6),
+    }
+    if select is None:
+        baseline = parse_s + sum(
+            rule_seconds.get(r, 0.0) for r in PRE_FLOW_RULES
+        )
+        ratio = (total / baseline) if baseline > 0 else 1.0
+        timing.update({
+            "baseline_seconds": round(baseline, 6),
+            "budget_ratio": TIME_BUDGET_RATIO,
+            "ratio": round(ratio, 4),
+            "within_budget": ratio <= TIME_BUDGET_RATIO,
+        })
+    return timing
 
 
 DEFAULT_PATHS = ("ytklearn_tpu", "scripts", "bench.py")
@@ -292,9 +470,9 @@ def report_json(report: dict, select: Optional[Sequence[str]] = None) -> dict:
     rules_run = sorted(
         RULES if select is None else {resolve_rule_name(s) for s in select}
     )
-    return {
+    doc = {
         "schema": "ytklint",
-        "schema_version": 1,
+        "schema_version": 2,
         "rules": rules_run,
         "files": report["files"],
         "findings": [
@@ -304,11 +482,46 @@ def report_json(report: dict, select: Optional[Sequence[str]] = None) -> dict:
         ],
         "suppressed": report["suppressed"],
     }
+    if "timing" in report:
+        doc["timing"] = report["timing"]
+    return doc
+
+
+def changed_files(base: str = "HEAD") -> Set[str]:
+    """Repo-relative paths changed vs `base` (plus untracked files) —
+    the --changed-only filter. Raises on git failure: a broken base ref
+    must not silently pass as an empty change set."""
+    import subprocess
+
+    out: Set[str] = set()
+    for cmd in (
+        ["git", "diff", "--name-only", base],
+        ["git", "ls-files", "--others", "--exclude-standard"],
+    ):
+        proc = subprocess.run(
+            cmd, cwd=str(_REPO_ROOT), capture_output=True, text=True
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"ytklint --changed-only: {' '.join(cmd)} failed: "
+                f"{proc.stderr.strip()}"
+            )
+        out.update(
+            line.strip() for line in proc.stdout.splitlines() if line.strip()
+        )
+    return out
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
     import argparse
     import json
+
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "names":
+        # metric name census / doc-sync CLI lives with the census code
+        from . import flow
+
+        return flow.names_main(argv[1:])
 
     ap = argparse.ArgumentParser(
         prog="ytklint",
@@ -324,6 +537,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     "(findings + live suppression inventory)")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule catalog and exit")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="report only findings in files changed vs --base "
+                    "(the whole-repo graph is still built, so cross-module "
+                    "rules stay sound)")
+    ap.add_argument("--base", default="HEAD", metavar="REF",
+                    help="base ref for --changed-only (default: HEAD)")
+    ap.add_argument("--timing-out", default=None, metavar="PATH",
+                    help="also write the json artifact (with the timing "
+                    "block) to PATH, independent of --format")
     args = ap.parse_args(argv)
 
     if args.list_rules:
@@ -346,7 +568,27 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     except FileNotFoundError as e:
         print(str(e), file=sys.stderr)
         return 2
+    if args.changed_only:
+        try:
+            changed = changed_files(args.base)
+        except RuntimeError as e:
+            print(str(e), file=sys.stderr)
+            return 2
+        before = len(report["findings"])
+        report["findings"] = [
+            f for f in report["findings"] if f.path in changed
+        ]
+        print(
+            f"ytklint: --changed-only kept {len(report['findings'])} of "
+            f"{before} finding(s) in {len(changed)} changed file(s) vs "
+            f"{args.base} (whole-repo graph still built)",
+            file=sys.stderr,
+        )
     findings = report["findings"]
+    if args.timing_out:
+        with open(args.timing_out, "w", encoding="utf-8") as fh:
+            json.dump(report_json(report, args.select), fh, indent=1)
+            fh.write("\n")
     if args.format == "json":
         json.dump(report_json(report, args.select), sys.stdout, indent=1)
         sys.stdout.write("\n")

@@ -118,6 +118,15 @@ class _TracedScopes:
         return iter(self.scopes)
 
 
+def _traced_scopes(ctx) -> "_TracedScopes":
+    """Per-file traced-scope map, cached on the FileContext — the jit
+    rules and the flow pass share one walk per file."""
+    got = getattr(ctx, "_traced_scopes", None)
+    if got is None:
+        got = ctx._traced_scopes = _TracedScopes(ctx.tree)
+    return got
+
+
 def _traced_value_names(fn: ast.FunctionDef, static: Set[str]) -> Set[str]:
     """Names that plausibly hold traced values inside `fn`: its own and
     nested functions' parameters, minus declared-static ones."""
@@ -149,7 +158,7 @@ def _references(node: ast.AST, names: Set[str]) -> bool:
     "inside a jit/shard_map-traced function",
 )
 def host_sync_in_jit(ctx) -> Iterable[Tuple[int, str]]:
-    for fn, static in _TracedScopes(ctx.tree):
+    for fn, static in _traced_scopes(ctx):
         traced = _traced_value_names(fn, static)
         for node in ast.walk(fn):
             if isinstance(node, ast.Call):
@@ -214,7 +223,7 @@ _TIME_CALLS = {"time.time", "time.perf_counter", "time.monotonic",
     "iteration, unhashable static args) inside a traced function",
 )
 def retrace_hazard(ctx) -> Iterable[Tuple[int, str]]:
-    for fn, _static in _TracedScopes(ctx.tree):
+    for fn, _static in _traced_scopes(ctx):
         # unhashable defaults become unhashable static args / weak closures
         for default in fn.args.defaults + [
             d for d in fn.args.kw_defaults if d is not None

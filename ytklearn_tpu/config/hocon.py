@@ -271,6 +271,7 @@ def loads(text: str) -> dict:
 
 
 def load(path: str) -> dict:
+    # ytklint: allow(unseamed-io) reason=startup config parse; runs once before any obs/retry plumbing exists, a missing config must fail loudly not retry
     with open(path, "r", encoding="utf-8") as f:
         return loads(f.read())
 

@@ -46,6 +46,7 @@ import numpy as np
 from ..config import hocon, knobs
 from ..config.params import CommonParams, GBDTParams
 from ..io.fs import FileSystem, create_filesystem, is_tmp_path
+from ..obs.recorder import thread_guard
 from ..obs import (
     configure as obs_configure,
     enabled as obs_enabled,
@@ -407,6 +408,7 @@ class RetrainLock:
         self._beater.start()
         return self
 
+    @thread_guard
     def _beat_loop(self, interval: float) -> None:
         while not self._stop.wait(interval):
             try:
@@ -503,6 +505,8 @@ def _fetch_drift_advisory() -> Optional[dict]:
     import urllib.request
 
     try:
+        chaos_point("continual.drift_fetch")
+        # ytklint: allow(unseamed-io) reason=advisory-only scrape; failure is recorded and never gates, so the retry seam would add retries the cycle must not wait on
         with urllib.request.urlopen(
             url.rstrip("/") + "/metrics?quality=1", timeout=10.0
         ) as r:

@@ -69,7 +69,7 @@ def load_transform_hook(path: str) -> Callable[[bytes], List[str]]:
     this (reference: dataflow/DataUtils.java:142, bin/transform.py); here it
     is plain Python."""
     ns: Dict = {}
-    with open(path) as f:
+    with LocalFileSystem().open(path) as f:
         exec(compile(f.read(), path, "exec"), ns)
     if "transform" not in ns:
         raise ValueError(f"{path} does not define transform(bytearray) -> [lines]")

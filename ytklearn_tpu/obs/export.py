@@ -91,10 +91,10 @@ def export_chrome_trace(path: str, registry: Registry = REGISTRY) -> str:
         "displayTimeUnit": "ms",
         "otherData": {"producer": "ytklearn_tpu.obs", "wall_t0": WALL_T0},
     }
-    tmp = path + ".tmp"
-    with open(tmp, "w") as f:
+    from ..io.fs import LocalFileSystem  # lazy: fs pulls the retry seam, which imports obs
+
+    with LocalFileSystem().atomic_open(path, "w") as f:
         json.dump(doc, f)
-    os.replace(tmp, path)
     return path
 
 
@@ -156,8 +156,9 @@ def export_jsonl(path: str, registry: Registry = REGISTRY) -> str:
         events = list(registry.events)
         counters = dict(registry.counters)
         gauges = dict(registry.gauges)
-    tmp = path + ".tmp"
-    with open(tmp, "w") as f:
+    from ..io.fs import LocalFileSystem  # lazy: fs pulls the retry seam, which imports obs
+
+    with LocalFileSystem().atomic_open(path, "w") as f:
         f.write(
             json.dumps(
                 {
@@ -181,7 +182,6 @@ def export_jsonl(path: str, registry: Registry = REGISTRY) -> str:
             f.write(
                 json.dumps({"type": "gauge", "name": name, "value": value}) + "\n"
             )
-    os.replace(tmp, path)
     return path
 
 
@@ -191,7 +191,9 @@ def load_jsonl(path: str) -> dict:
     events: List[dict] = []
     counters: Dict[str, float] = {}
     gauges: Dict[str, float] = {}
-    with open(path) as f:
+    from ..io.fs import LocalFileSystem  # lazy: fs pulls the retry seam, which imports obs
+
+    with LocalFileSystem().open(path) as f:
         for line in f:
             line = line.strip()
             if not line:

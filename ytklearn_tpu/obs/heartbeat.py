@@ -24,6 +24,7 @@ import time
 from typing import Dict, Optional
 
 from . import core
+from .recorder import thread_guard
 from ..config import knobs
 
 log = logging.getLogger("ytklearn_tpu.obs")
@@ -102,6 +103,7 @@ _sampler_stop: Optional[threading.Event] = None
 _sampler_lock = threading.Lock()
 
 
+@thread_guard
 def _sampler_loop(stop: threading.Event, interval_s: float) -> None:
     while not stop.wait(interval_s):
         if core.enabled():

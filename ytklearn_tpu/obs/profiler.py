@@ -48,6 +48,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ..config import knobs
 from . import core
+from .recorder import thread_guard
 
 log = logging.getLogger("ytklearn_tpu.obs.profiler")
 
@@ -483,6 +484,7 @@ def _host_rss_bytes() -> Optional[float]:
     """Current RSS from /proc (linux); falls back to ru_maxrss (a peak,
     but monotone — still a usable watermark signal)."""
     try:
+        # ytklint: allow(unseamed-io) reason=/proc pseudo-file sampler; local kernel read, no durability or retry semantics apply
         with open("/proc/self/status") as fh:
             for line in fh:
                 if line.startswith("VmRSS:"):
@@ -554,6 +556,7 @@ class MemWatermarkSampler:
         if rss is not None:
             core.gauge("mem.sampled.host_rss_bytes", rss)
 
+    @thread_guard
     def _run(self, stop: threading.Event, interval: float) -> None:
         while not stop.is_set():
             self.sample_once()
@@ -625,7 +628,9 @@ def _load_trace_doc(path: str) -> Optional[dict]:
         if path.endswith(".gz"):
             with gzip.open(path, "rt") as fh:
                 return json.load(fh)
-        with open(path) as fh:
+        from ..io.fs import LocalFileSystem  # lazy: fs pulls the retry seam, which imports obs
+
+        with LocalFileSystem().open(path) as fh:
             return json.load(fh)
     except Exception as e:  # partial/corrupt captures are skipped, not fatal
         log.debug("trace parse failed for %s: %s", path, e)

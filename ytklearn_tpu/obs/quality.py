@@ -68,6 +68,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from . import core, health
+from .recorder import thread_guard
 from ..config import knobs
 from ..gbdt.quantile_sketch import (
     Summary,
@@ -950,6 +951,7 @@ _evaluator_stop: Optional[threading.Event] = None
 _evaluator_lock = threading.Lock()
 
 
+@thread_guard
 def _evaluator_loop(stop: threading.Event, interval_s: float) -> None:
     while not stop.wait(interval_s):
         try:

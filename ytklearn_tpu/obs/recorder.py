@@ -97,6 +97,37 @@ def last_dump_path() -> Optional[str]:
     return _state.last_dump_path
 
 
+def thread_guard(fn):
+    """Decorator for thread entry points: a worker must not die silently.
+
+    An exception escaping a ``Thread(target=...)`` entry evaporates into
+    threading's default excepthook — no obs event, nothing in the flight
+    ring, and the first symptom is a subsystem that quietly stopped (the
+    r14 respawn bug's failure mode). The guard logs the exception, drops
+    a ``thread.died`` event into the ring (so a later flight dump names
+    the dead worker), and re-raises — semantics are otherwise unchanged.
+    ytklint's silent-thread-death rule recognizes this decorator.
+    """
+    import functools
+
+    @functools.wraps(fn)
+    def _guarded(*args, **kwargs):
+        try:
+            return fn(*args, **kwargs)
+        except Exception as e:
+            log.exception(
+                "thread entry %s died: %s: %s",
+                getattr(fn, "__qualname__", fn), type(e).__name__, e,
+            )
+            core.event(
+                "thread.died",
+                entry=getattr(fn, "__qualname__", str(fn)),
+                error=type(e).__name__,
+            )
+            raise
+    return _guarded
+
+
 def set_config_fingerprint(obj) -> None:
     """Record a compact fingerprint of the run config for the dump —
     a stable hash plus a short head of the repr (enough to tell two runs

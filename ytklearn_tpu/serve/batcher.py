@@ -32,6 +32,7 @@ import numpy as np
 
 from ..obs import event as obs_event, gauge as obs_gauge, inc as obs_inc, span as obs_span
 from ..obs import trace as obs_trace
+from ..obs.recorder import thread_guard
 
 
 class OverloadError(RuntimeError):
@@ -304,6 +305,7 @@ class MicroBatcher:
             obs_gauge("serve.queue_depth", len(self._queue))
             return batch
 
+    @thread_guard
     def _loop(self) -> None:
         while True:
             batch = self._take_batch()

@@ -95,6 +95,7 @@ from typing import Dict, Optional
 from ...config import knobs
 from ...obs import event as obs_event, inc as obs_inc
 from ...obs.core import REGISTRY as OBS_REGISTRY
+from ...obs.recorder import thread_guard
 
 log = logging.getLogger("ytklearn_tpu.serve.fleet")
 
@@ -397,6 +398,7 @@ class FleetAutoscaler:
 
     # -- the control loop -------------------------------------------------
 
+    @thread_guard
     def _loop(self) -> None:
         while not self._stop_evt.wait(self.interval_s):
             try:

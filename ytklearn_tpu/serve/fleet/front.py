@@ -73,6 +73,7 @@ from ...obs import health as obs_health
 from ...obs import trace as obs_trace
 from ...obs.core import REGISTRY as OBS_REGISTRY
 from ...obs.heartbeat import start_history_sampler
+from ...obs.recorder import thread_guard
 from ...resilience import is_transient
 from ..batcher import (
     BatchPolicy,
@@ -380,6 +381,7 @@ class FleetFront:
         self.latency = _LatencyWindow()
         errors: Dict[int, BaseException] = {}
 
+        @thread_guard
         def _spawn(rid: int) -> None:
             try:
                 h = spawn_replica(
@@ -430,6 +432,7 @@ class FleetFront:
                  {rid: h.port for rid, h in sorted(self.handles.items())})
         return self
 
+    @thread_guard
     def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
         self.draining = True
         self._closing = True
@@ -782,6 +785,7 @@ class FleetFront:
 
     # -- healing ----------------------------------------------------------
 
+    @thread_guard
     def _monitor_loop(self) -> None:
         while not self._stop_evt.wait(self.monitor_interval_s):
             for rid, h in list(self.handles.items()):
@@ -847,6 +851,7 @@ class FleetFront:
             self._respawns[rid] = t
             t.start()
 
+    @thread_guard
     def _do_restart(self, rid: int, h: ReplicaHandle) -> None:
         # reap the corpse before respawning the slot
         if h.proc is not None and h.proc.poll() is None:
@@ -929,6 +934,7 @@ class FleetFront:
         log.info("fleet: scaling up -> slot %d spawning", rid)
         return True
 
+    @thread_guard
     def _do_scale_spawn(self, rid: int, h: ReplicaHandle,
                         reason: Optional[dict]) -> None:
         try:
@@ -1143,6 +1149,7 @@ class FleetFront:
         handles = sorted(self.handles.items())
         results: Dict[int, dict] = {}
 
+        @thread_guard
         def _scrape(rid, h):
             results[rid] = self._scrape_replica(
                 rid, h, quality=quality, prof=prof, models=models
@@ -1236,6 +1243,7 @@ class FleetFront:
         handles = sorted(self.handles.items())
         results: Dict[int, dict] = {}
 
+        @thread_guard
         def _scrape(rid, h):
             try:
                 status, body = http_json(

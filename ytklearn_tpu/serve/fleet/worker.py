@@ -34,6 +34,7 @@ import time
 from typing import Callable, Dict, List, Optional
 
 from ...resilience import retry_call
+from ...obs.recorder import thread_guard
 
 log = logging.getLogger("ytklearn_tpu.serve.fleet")
 
@@ -127,6 +128,7 @@ def _read_banner(proc: subprocess.Popen, timeout_s: float) -> dict:
     wedged worker can't hang the front."""
     out: List[str] = []
 
+    @thread_guard
     def _read():
         try:
             out.append(proc.stdout.readline())
@@ -204,8 +206,10 @@ def spawn_replica(
         if log_dir:
             os.makedirs(log_dir, exist_ok=True)
             h.log_path = os.path.join(log_dir, f"replica_{replica_id}.log")
+            # ytklint: allow(unseamed-io) reason=replica stderr sink handed to Popen; must be a real fd, and _once runs under retry_call(site="serve.worker") below
             stderr = open(h.log_path, "ab")
         try:
+            # ytklint: allow(unseamed-io) reason=this IS the process-spawn seam; _once runs under retry_call(site="serve.worker") below
             proc = subprocess.Popen(
                 list(argv) + ["--replica-id", str(replica_id)],
                 stdout=subprocess.PIPE,
@@ -244,6 +248,7 @@ def spawn_replica(
     return h
 
 
+@thread_guard
 def stop_replica(h: ReplicaHandle, timeout_s: float = 30.0,
                  reason: str = "shutdown") -> None:
     """SIGTERM (the worker drains in-flight work), escalate to kill.

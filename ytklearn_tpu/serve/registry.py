@@ -43,6 +43,7 @@ from typing import Dict, Optional
 from ..config import knobs
 from ..io.fs import is_tmp_path
 from ..obs import event as obs_event, gauge as obs_gauge, inc as obs_inc
+from ..obs.recorder import thread_guard
 from ..predict import create_predictor
 from ..resilience import chaos_point, retry_call
 from .scorer import CompiledScorer
@@ -313,6 +314,7 @@ class ModelRegistry:
         )
         self._watcher.start()
 
+    @thread_guard
     def _watch_loop(self) -> None:
         while not self._stop.wait(self.watch_interval_s):
             for name in self.names():

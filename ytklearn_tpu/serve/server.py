@@ -57,6 +57,7 @@ from ..obs import quality as obs_quality
 from ..obs import trace as obs_trace
 from ..obs.core import REGISTRY as OBS_REGISTRY
 from ..obs.heartbeat import start_history_sampler
+from ..obs.recorder import thread_guard
 from ..resilience import chaos_point
 from .batcher import (
     BatchPolicy,
@@ -704,6 +705,7 @@ class ServeApp:
                  self.host, self.port, len(self.registry))
         return self
 
+    @thread_guard
     def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
         """Graceful by default: refuse new work, finish queued requests,
         then stop the listener and the reload watcher."""
